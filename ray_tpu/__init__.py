@@ -1,0 +1,86 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Capability surface modeled on the reference framework (see SURVEY.md): tasks,
+actors, a distributed object store, placement groups, and train/tune/data/
+serve/RL libraries on top — but designed TPU-first: the scheduler's unit of
+accelerator is the TPU chip and the ICI-connected slice, and all dense-math
+data movement is compiled XLA collectives (jax.lax psum/all_gather/ppermute)
+rather than NCCL.
+
+Public core API (parity surface: reference python/ray/__init__.py):
+
+    import ray_tpu as rt
+
+    rt.init()
+    @rt.remote
+    def f(x): return x + 1
+    ref = f.remote(1)
+    rt.get(ref)           # -> 2
+
+    @rt.remote
+    class Counter:
+        def __init__(self): self.n = 0
+        def inc(self): self.n += 1; return self.n
+    c = Counter.remote()
+    rt.get(c.inc.remote())  # -> 1
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.core.api import (
+    init,
+    shutdown,
+    is_initialized,
+    get,
+    put,
+    wait,
+    remote,
+    cancel,
+    kill,
+    get_actor,
+    get_runtime_context,
+    timeline,
+    nodes,
+    cluster_resources,
+    available_resources,
+    method,
+)
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu.core.actor import ActorHandle
+from ray_tpu.core.exceptions import (
+    RayTpuError,
+    TaskError,
+    ActorError,
+    ActorDiedError,
+    ObjectLostError,
+    GetTimeoutError,
+    WorkerCrashedError,
+)
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "get",
+    "put",
+    "wait",
+    "remote",
+    "cancel",
+    "kill",
+    "get_actor",
+    "method",
+    "get_runtime_context",
+    "timeline",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "ObjectRef",
+    "ActorHandle",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "WorkerCrashedError",
+]

@@ -1,0 +1,135 @@
+"""Runtime configuration flag registry.
+
+Role parity: the reference's ``RAY_CONFIG(type, name, default)`` macro registry
+(src/ray/common/ray_config_def.h:22, 198 entries) with per-process env-var
+overrides (``RAY_<name>``) and a ``_system_config`` dict passed at init.
+
+Here every flag is declared once with a type and default; ``RT_<NAME>`` env
+vars override; ``init(_system_config={...})`` overrides both for the session
+and is propagated to spawned daemons/workers through their environment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RT_"
+_SYSTEM_CONFIG_ENV = "RT_SYSTEM_CONFIG_JSON"
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: Callable[[Any], Any]
+    default: Any
+    doc: str
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_overrides: Dict[str, Any] = {}
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def define(name: str, type_: Callable, default: Any, doc: str = "") -> None:
+    if type_ is bool:
+        type_ = _parse_bool
+    _REGISTRY[name] = _Flag(name, type_, default, doc)
+
+
+def get(name: str) -> Any:
+    flag = _REGISTRY[name]
+    if name in _overrides:
+        return _overrides[name]
+    env = os.environ.get(_ENV_PREFIX + name.upper())
+    if env is not None:
+        return flag.type(env)
+    return flag.default
+
+
+def set_system_config(cfg: Dict[str, Any]) -> None:
+    """Apply a session-level override dict (validated against the registry)."""
+    for k, v in cfg.items():
+        if k not in _REGISTRY:
+            raise ValueError(f"Unknown system config flag: {k!r}")
+        _overrides[k] = _REGISTRY[k].type(v)
+
+
+def load_from_env() -> None:
+    """Pick up a propagated system-config blob (set by the parent process)."""
+    blob = os.environ.get(_SYSTEM_CONFIG_ENV)
+    if blob:
+        set_system_config(json.loads(blob))
+
+
+def serialized_overrides() -> str:
+    return json.dumps(_overrides)
+
+
+def propagation_env() -> Dict[str, str]:
+    """Env vars a child daemon/worker needs to see the same config."""
+    env = {}
+    if _overrides:
+        env[_SYSTEM_CONFIG_ENV] = serialized_overrides()
+    return env
+
+
+def all_flags() -> Dict[str, Any]:
+    return {name: get(name) for name in _REGISTRY}
+
+
+# --------------------------------------------------------------------------
+# Flag definitions. Grouped by subsystem.
+# --------------------------------------------------------------------------
+
+# Object store
+define("object_store_memory_mb", int, 2048, "Per-node shm object store capacity.")
+define("max_inline_object_bytes", int, 100 * 1024,
+       "Results/args at or below this size travel inline in RPCs instead of "
+       "through the shared-memory store (reference: max_direct_call_object_size).")
+define("object_spill_dir", str, "", "Directory for spilled objects ('' = session dir).")
+define("object_store_eviction_watermark", float, 0.8,
+       "Fraction of store capacity above which LRU eviction of unreferenced "
+       "sealed objects begins.")
+
+# Scheduling
+define("worker_pool_min_size", int, 0, "Workers prestarted per node at boot.")
+define("worker_pool_max_size", int, 8, "Max concurrent leased workers per node.")
+define("worker_idle_timeout_s", float, 60.0, "Idle worker reap timeout.")
+define("lease_reuse_enabled", bool, True,
+       "Reuse a granted worker lease for queued tasks with the same scheduling "
+       "key (the reference's lease-reuse fast path, direct_task_transport.cc).")
+define("scheduler_spread_threshold", float, 0.5,
+       "Hybrid policy: prefer local node until its critical-resource "
+       "utilization exceeds this fraction, then best-score remote.")
+define("max_pending_lease_requests", int, 10, "In-flight lease requests per key.")
+
+# Health / fault tolerance
+define("health_check_period_s", float, 1.0, "Conductor -> node liveness ping period.")
+define("health_check_timeout_s", float, 10.0, "Misses before a node is marked dead.")
+define("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
+define("actor_max_restarts_default", int, 0, "Default actor restarts.")
+define("testing_rpc_delay_us", int, 0,
+       "Deterministic delay injected before serving matching RPCs; format "
+       "'method=us' pairs comma-separated, or bare int for all methods "
+       "(reference: RAY_testing_asio_delay_us).")
+
+# Transport
+define("rpc_connect_timeout_s", float, 10.0, "Client connect timeout.")
+define("rpc_message_max_bytes", int, 512 * 1024 * 1024, "Max framed message size.")
+
+# TPU
+define("tpu_force_host_platform", bool, False,
+       "Treat CPU devices as the TPU plane (for tests on a virtual mesh).")
+define("tpu_chips_per_host_override", int, 0, "0 = autodetect from jax.")
+
+# Observability
+define("task_event_buffer_size", int, 65536, "Task lifecycle events retained.")
+define("metrics_export_period_s", float, 5.0, "Metrics flush period.")

@@ -1,0 +1,90 @@
+"""Strongly-typed binary IDs.
+
+Role parity: src/ray/common/id.h — every entity (object, task, actor, node,
+worker, job, placement group) gets a fixed-width random ID with a typed
+wrapper so they cannot be mixed up.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class BaseID:
+    SIZE = 16
+    __slots__ = ("_bytes",)
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}")
+        self._bytes = binary
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    SIZE = 20
+
+
+class TaskID(BaseID):
+    SIZE = 16
+
+    _counter_lock = threading.Lock()
+    _counter = 0
+
+    def object_id_for_return(self, index: int) -> ObjectID:
+        """Deterministically derive the i-th return ObjectID of this task."""
+        return ObjectID(self._bytes + index.to_bytes(4, "little"))
+
+
+class ActorID(BaseID):
+    SIZE = 12
+
+
+class NodeID(BaseID):
+    SIZE = 12
+
+
+class WorkerID(BaseID):
+    SIZE = 12
+
+
+class JobID(BaseID):
+    SIZE = 8
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 12

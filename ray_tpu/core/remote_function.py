@@ -1,0 +1,56 @@
+"""@remote function handle.
+
+Role parity: python/ray/remote_function.py:34 (RemoteFunction, `_remote` at
+:240) — holds the user callable plus default options; ``.options()`` returns
+a derived handle; ``.remote()`` submits through the connected runtime.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Union
+
+from ray_tpu.core.options import TaskOptions, make_task_options
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu.core.task_spec import FunctionDescriptor
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: TaskOptions):
+        if not callable(fn):
+            raise TypeError("@remote must wrap a callable")
+        self._fn = fn
+        self._opts = options
+        self._descriptor = None   # lazily computed (pickle cost)
+        self._blob = None
+        functools.update_wrapper(self, fn)
+
+    # -- descriptor caching ------------------------------------------------
+    def _desc_and_blob(self):
+        if self._descriptor is None:
+            self._descriptor, self._blob = FunctionDescriptor.for_callable(self._fn)
+        return self._descriptor, self._blob
+
+    # -- public API --------------------------------------------------------
+    def options(self, **updates) -> "RemoteFunction":
+        rf = RemoteFunction(self._fn, make_task_options(self._opts, **updates))
+        rf._descriptor, rf._blob = self._desc_and_blob()
+        return rf
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        from ray_tpu.core.api import _global_runtime
+        rt = _global_runtime()
+        desc, blob = self._desc_and_blob()
+        refs = rt.submit_task(desc, blob, args, kwargs, self._opts)
+        if self._opts.num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._fn.__qualname__!r} cannot be called "
+            "directly; use .remote() (or access the original via .func).")
+
+    @property
+    def func(self):
+        return self._fn

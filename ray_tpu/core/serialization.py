@@ -1,0 +1,168 @@
+"""Object serialization: cloudpickle + out-of-band zero-copy buffers.
+
+Role parity: python/ray/_private/serialization.py — values are pickled with
+protocol 5; large contiguous buffers (numpy arrays, bytes) are extracted
+out-of-band so readers can map them zero-copy out of shared memory.
+ObjectRefs contained in a value are collected during serialization so the
+runtime can track borrowing and task dependencies (reference_count.h:61).
+
+Wire layout of a serialized object:
+
+    [8B magic+version][8B pickle_len][4B nbuf]
+    [8B len + pad-to-64 for each buffer] ... header, then:
+    [pickle bytes][pad][buffer 0][pad][buffer 1] ...
+
+Buffers are 64-byte aligned relative to the start of the blob so that a
+reader holding the blob in an aligned shm mapping can reconstruct numpy
+arrays as views without copying.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+from ray_tpu.core.refs import ObjectRef
+
+_MAGIC = b"RTOB\x00\x00\x00\x01"
+_ALIGN = 64
+
+
+def _pad(n: int) -> int:
+    return (-n) % _ALIGN
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, buffer_callback):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self.contained_refs: List[ObjectRef] = []
+
+    def persistent_id(self, obj):
+        return None
+
+    def reducer_override(self, obj):
+        if isinstance(obj, ObjectRef):
+            self.contained_refs.append(obj)
+        if isinstance(obj, _JaxArrayPlaceholder.jax_array_types()):
+            import numpy as np
+            return (_restore_array, (np.asarray(obj),))
+        # Defer to cloudpickle's own override (functions, classes, ...).
+        return super().reducer_override(obj)
+
+
+class _JaxArrayPlaceholder:
+    _types = None
+
+    @classmethod
+    def jax_array_types(cls):
+        if cls._types is None:
+            try:
+                import jax
+                cls._types = (jax.Array,)
+            except Exception:  # pragma: no cover
+                cls._types = ()
+        return cls._types
+
+
+def _restore_array(arr):
+    return arr
+
+
+def serialize(value: Any) -> Tuple[bytes, List[ObjectRef]]:
+    """Serialize ``value``; returns (blob, contained ObjectRefs)."""
+    import io
+
+    buffers: List[pickle.PickleBuffer] = []
+    bio = io.BytesIO()
+    p = _Pickler(bio, buffers.append)
+    p.dump(value)
+    pickled = bio.getvalue()
+
+    raw: List[memoryview] = []
+    for b in buffers:
+        m = b.raw()
+        if not m.contiguous:
+            m = memoryview(bytes(m))
+        raw.append(m)
+
+    header = bytearray()
+    header += _MAGIC
+    header += struct.pack("<QI", len(pickled), len(raw))
+    for m in raw:
+        header += struct.pack("<Q", m.nbytes)
+
+    out = bytearray(header)
+    out += pickled
+    out += b"\x00" * _pad(len(out))
+    for m in raw:
+        out += m
+        out += b"\x00" * _pad(len(out))
+    return bytes(out), p.contained_refs
+
+
+def serialized_size(blob: bytes) -> int:
+    return len(blob)
+
+
+def deserialize(blob) -> Any:
+    """Deserialize from a bytes-like (bytes or an shm-backed memoryview).
+
+    When ``blob`` is a memoryview over shared memory, buffer-backed arrays are
+    reconstructed as zero-copy views over that memory.
+    """
+    m = memoryview(blob)
+    if bytes(m[:8]) != _MAGIC:
+        raise ValueError("bad object blob magic")
+    pickle_len, nbuf = struct.unpack_from("<QI", m, 8)
+    off = 20
+    buf_lens = []
+    for i in range(nbuf):
+        (blen,) = struct.unpack_from("<Q", m, off)
+        buf_lens.append(blen)
+        off += 8
+    body = off
+    pickled = m[body:body + pickle_len]
+    cur = body + pickle_len
+    cur += _pad(cur)
+    bufs = []
+    for blen in buf_lens:
+        bufs.append(m[cur:cur + blen])
+        cur += blen
+        cur += _pad(cur)
+    return pickle.loads(pickled, buffers=bufs)
+
+
+def dumps(value: Any) -> bytes:
+    """Plain cloudpickle (control-plane payloads: task specs, functions)."""
+    return cloudpickle.dumps(value, protocol=5)
+
+
+def loads(blob: bytes) -> Any:
+    return pickle.loads(blob)
+
+
+def collect_refs(value: Any) -> List[ObjectRef]:
+    """Find ObjectRefs inside a value without a full re-serialize when cheap.
+
+    Falls back to a serializing walk for arbitrary nesting.
+    """
+    if isinstance(value, ObjectRef):
+        return [value]
+    if isinstance(value, (list, tuple, set)):
+        out: List[ObjectRef] = []
+        for v in value:
+            out.extend(collect_refs(v))
+        return out
+    if isinstance(value, dict):
+        out = []
+        for k, v in value.items():
+            out.extend(collect_refs(k))
+            out.extend(collect_refs(v))
+        return out
+    if isinstance(value, (int, float, str, bytes, bool, type(None))):
+        return []
+    _, refs = serialize(value)
+    return refs

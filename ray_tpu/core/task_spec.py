@@ -1,0 +1,84 @@
+"""TaskSpec — the unit handed from submitter to scheduler to executor.
+
+Role parity: src/ray/common/task/task_spec.h (TaskSpecification /
+TaskSpecBuilder). Functions are shipped by content-hash descriptor and cached
+by workers (reference: gcs_function_manager.h function table), so a hot loop
+submitting the same function pays pickling once.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.options import ActorOptions, TaskOptions
+
+
+@dataclass
+class FunctionDescriptor:
+    """Content-addressed handle for a remote function or actor class."""
+    function_id: str              # sha1 of the pickled callable
+    module: str
+    qualname: str
+
+    @classmethod
+    def for_callable(cls, fn) -> Tuple["FunctionDescriptor", bytes]:
+        blob = serialization.dumps(fn)
+        fid = hashlib.sha1(blob).hexdigest()
+        return (
+            cls(function_id=fid,
+                module=getattr(fn, "__module__", "") or "",
+                qualname=getattr(fn, "__qualname__", repr(fn))),
+            blob,
+        )
+
+    def repr_name(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    descriptor: FunctionDescriptor
+    # Serialized (args, kwargs) blob; refs inside were extracted at submit
+    # time into ``dependencies`` and are resolved by the executing worker.
+    args_blob: bytes
+    dependencies: List[ObjectID]
+    num_returns: int
+    resources: Dict[str, float]
+    name: str = ""
+    max_retries: int = 0
+    retry_exceptions: Any = False
+    scheduling_strategy: Any = None
+    # Actor-task fields
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    sequence_no: int = -1          # per-(caller, actor) ordering
+    # Actor-creation fields
+    is_actor_creation: bool = False
+    actor_options: Optional[ActorOptions] = None
+    # Caller identity (owner of the returned objects)
+    caller_address: str = ""
+
+    def return_ids(self) -> List[ObjectID]:
+        return [self.task_id.object_id_for_return(i)
+                for i in range(self.num_returns)]
+
+    def scheduling_key(self) -> tuple:
+        """Tasks with equal keys can reuse one worker lease
+        (reference: direct_task_transport SchedulingKey)."""
+        return (
+            self.descriptor.function_id,
+            tuple(sorted(self.resources.items())),
+            repr(self.scheduling_strategy),
+        )
+
+    def desc(self) -> str:
+        base = self.name or self.descriptor.repr_name()
+        if self.actor_id is not None and not self.is_actor_creation:
+            return f"{base}.{self.method_name}"
+        return base

@@ -1,0 +1,27 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform.
+
+Parity: the reference tests distributed behavior without real hardware via an
+in-process multi-node fixture (python/ray/cluster_utils.py:99) and a fake
+multi-node autoscaler provider; the TPU analog is an 8-device CPU mesh
+(xla_force_host_platform_device_count) standing in for a slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def local_rt():
+    """A fresh in-process runtime per test."""
+    import ray_tpu
+    ray_tpu.shutdown()
+    ray_tpu.init(local_mode=True, num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()

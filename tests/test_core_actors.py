@@ -1,0 +1,261 @@
+"""Actor tests (local mode) — parity coverage: test_actor.py basics."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import TaskError
+
+
+def test_actor_basic(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, by=1):
+            self.n += by
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert rt.get(c.inc.remote()) == 11
+    assert rt.get(c.inc.remote(5)) == 16
+    assert rt.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(50):
+        log.append.remote(i)
+    assert rt.get(log.get.remote()) == list(range(50))
+
+
+def test_actor_error(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    class Bad:
+        def boom(self):
+            raise KeyError("nope")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(TaskError):
+        rt.get(b.boom.remote())
+    # Actor survives a method error.
+    assert rt.get(b.ok.remote()) == 1
+
+
+def test_actor_handle_passing(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.v = None
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @rt.remote
+    def writer(store, v):
+        rt_inner = ray_tpu
+        rt_inner.get(store.set.remote(v))
+        return True
+
+    s = Store.remote()
+    assert rt.get(writer.remote(s, 42))
+    assert rt.get(s.get.remote()) == 42
+
+
+def test_async_actor(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncWorker.remote()
+    refs = [a.work.remote(i) for i in range(8)]
+    assert rt.get(refs) == [2 * i for i in range(8)]
+
+
+def test_named_actor(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    class Singleton:
+        def ping(self):
+            return "pong"
+
+    Singleton.options(name="the-one").remote()
+    h = rt.get_actor("the-one")
+    assert rt.get(h.ping.remote()) == "pong"
+    with pytest.raises(ValueError):
+        Singleton.options(name="the-one").remote()
+    # get_if_exists returns the existing one instead of raising.
+    h2 = Singleton.options(name="the-one", get_if_exists=True).remote()
+    assert rt.get(h2.ping.remote()) == "pong"
+
+
+def test_kill_actor(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    class Victim:
+        def ping(self):
+            return 1
+
+    v = Victim.remote()
+    assert rt.get(v.ping.remote()) == 1
+    rt.kill(v)
+    with pytest.raises(TaskError):
+        rt.get(v.ping.remote())
+
+
+def test_max_concurrency(local_rt):
+    rt = local_rt
+    import time
+
+    @rt.remote(max_concurrency=4)
+    class Parallel:
+        def slow(self):
+            time.sleep(0.2)
+            return 1
+
+    p = Parallel.remote()
+    t0 = time.monotonic()
+    rt.get([p.slow.remote() for _ in range(4)])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.7, f"calls did not overlap: {elapsed:.2f}s"
+
+
+def test_method_options(local_rt):
+    rt = local_rt
+    from ray_tpu import method
+
+    @rt.remote
+    class Multi:
+        @method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    m = Multi.remote()
+    a, b = m.pair.remote()
+    assert rt.get([a, b]) == [1, 2]
+
+
+def test_async_actor_dep_on_own_result(local_rt):
+    """An async actor consuming a ref produced by its own earlier call must
+    not deadlock its event loop (arg resolution happens off-loop)."""
+    rt = local_rt
+
+    @rt.remote(max_concurrency=4)
+    class Chain:
+        async def produce(self):
+            import asyncio
+            await asyncio.sleep(0.05)
+            return 7
+
+        async def consume(self, x):
+            return x + 1
+
+    a = Chain.remote()
+    r1 = a.produce.remote()
+    r2 = a.consume.remote(r1)
+    assert rt.get(r2, timeout=10) == 8
+
+
+def test_kill_fails_inflight_calls(local_rt):
+    rt = local_rt
+    import time
+    from ray_tpu.core.exceptions import TaskError
+
+    @rt.remote
+    class Slow:
+        def work(self):
+            time.sleep(5)
+            return 1
+
+    s = Slow.remote()
+    r1 = s.work.remote()
+    r2 = s.work.remote()   # queued behind r1
+    time.sleep(0.1)
+    rt.kill(s)
+    import pytest
+    for r in (r1, r2):
+        with pytest.raises(TaskError):
+            rt.get(r, timeout=5)
+
+
+def test_wait_pending_list_unique(local_rt):
+    rt = local_rt
+    import time
+
+    @rt.remote
+    def fast():
+        return 1
+
+    @rt.remote
+    def slow():
+        time.sleep(3)
+        return 2
+
+    s, f = slow.remote(), fast.remote()
+    ready, pending = rt.wait([s, f], num_returns=1, timeout=2)
+    assert ready == [f]
+    assert pending == [s]
+    # The canonical drain loop must work on the returned pending list.
+    ready2, pending2 = rt.wait(pending, num_returns=1, timeout=5)
+    assert ready2 == [s] and pending2 == []
+
+
+def test_named_actor_race(local_rt):
+    rt = local_rt
+    import threading
+
+    @rt.remote
+    class One:
+        def ping(self):
+            return 1
+
+    results = []
+
+    def create():
+        try:
+            One.options(name="racer").remote()
+            results.append("ok")
+        except ValueError:
+            results.append("taken")
+
+    ts = [threading.Thread(target=create) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results.count("ok") == 1, results

@@ -1,0 +1,233 @@
+"""Core API tests (local mode).
+
+Modeled on the reference's python/ray/tests/test_basic.py coverage: put/get
+round-trips, task submit, nested refs, num_returns, error propagation, wait
+semantics, options validation.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization
+from ray_tpu.core.exceptions import GetTimeoutError, TaskError
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.options import make_task_options
+from ray_tpu.core.refs import ObjectRef
+
+
+def test_put_get_roundtrip(local_rt):
+    rt = local_rt
+    for value in [1, "x", [1, 2, {"a": (3, 4)}], None, b"bytes",
+                  np.arange(10)]:
+        ref = rt.put(value)
+        out = rt.get(ref)
+        if isinstance(value, np.ndarray):
+            np.testing.assert_array_equal(out, value)
+        else:
+            assert out == value
+
+
+def test_task_submit_and_get(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2)) == 3
+    refs = [add.remote(i, i) for i in range(20)]
+    assert rt.get(refs) == [2 * i for i in range(20)]
+
+
+def test_task_arg_ref_resolution(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    @rt.remote
+    def combine(a, b):
+        return a + b
+
+    x = rt.put(10)
+    r1 = double.remote(x)          # top-level ref resolved to value
+    r2 = combine.remote(r1, 5)
+    assert rt.get(r2) == 25
+
+
+def test_nested_ref_not_resolved(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    def peek(d):
+        return isinstance(d["ref"], ObjectRef)
+
+    assert rt.get(peek.remote({"ref": rt.put(1)}))
+
+
+def test_num_returns(local_rt):
+    rt = local_rt
+
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c]) == [1, 2, 3]
+
+
+def test_error_propagation(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError) as ei:
+        rt.get(boom.remote())
+    assert "kaboom" in str(ei.value)
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_error_propagates_through_dependency(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    def boom():
+        raise RuntimeError("first failure")
+
+    @rt.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(TaskError):
+        rt.get(consume.remote(boom.remote()))
+
+
+def test_wait(local_rt):
+    rt = local_rt
+    import time
+
+    @rt.remote
+    def fast():
+        return "fast"
+
+    @rt.remote
+    def slow():
+        time.sleep(2.0)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = rt.wait([f, s], num_returns=1, timeout=1.5)
+    assert ready == [f] and pending == [s]
+    ready, pending = rt.wait([s], num_returns=1, timeout=0.01)
+    assert ready == [] and pending == [s]
+
+
+def test_get_timeout(local_rt):
+    rt = local_rt
+    import time
+
+    @rt.remote
+    def slow():
+        time.sleep(5)
+
+    with pytest.raises(GetTimeoutError):
+        rt.get(slow.remote(), timeout=0.05)
+
+
+def test_options_override(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    def one():
+        return 1
+
+    assert rt.get(one.options(name="renamed").remote()) == 1
+    with pytest.raises(ValueError):
+        one.options(bogus_option=1)
+
+
+def test_direct_call_rejected(local_rt):
+    rt = local_rt
+
+    @rt.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_closure_capture(local_rt):
+    rt = local_rt
+    factor = 7
+
+    @rt.remote
+    def mul(x):
+        return factor * x
+
+    assert rt.get(mul.remote(6)) == 42
+
+
+# ---------------------------------------------------------------------------
+# IDs and serialization unit tests
+# ---------------------------------------------------------------------------
+
+def test_ids():
+    t = TaskID.from_random()
+    o0, o1 = t.object_id_for_return(0), t.object_id_for_return(1)
+    assert o0 != o1
+    assert o0 == t.object_id_for_return(0)
+    assert ObjectID.from_hex(o0.hex()) == o0
+    assert TaskID.nil().is_nil()
+    with pytest.raises(ValueError):
+        ObjectID(b"short")
+
+
+def test_serialization_roundtrip():
+    value = {"a": np.arange(1000, dtype=np.float32), "b": [1, "two", None]}
+    blob, refs = serialization.serialize(value)
+    assert refs == []
+    out = serialization.deserialize(blob)
+    np.testing.assert_array_equal(out["a"], value["a"])
+    assert out["b"] == value["b"]
+
+
+def test_serialization_zero_copy():
+    arr = np.arange(4096, dtype=np.float64)
+    blob, _ = serialization.serialize({"x": arr})
+    out = serialization.deserialize(memoryview(blob))
+    np.testing.assert_array_equal(out["x"], arr)
+
+
+def test_serialization_collects_refs():
+    ref = ObjectRef(ObjectID.from_random())
+    blob, refs = serialization.serialize({"nested": [ref, 1]})
+    assert refs == [ref]
+    out = serialization.deserialize(blob)
+    assert out["nested"][0] == ref
+
+
+def test_serialization_lambda():
+    blob, _ = serialization.serialize(lambda x: x * 3)
+    fn = serialization.deserialize(blob)
+    assert fn(2) == 6
+
+
+def test_jax_array_serialization():
+    import jax.numpy as jnp
+    x = jnp.arange(16.0)
+    blob, _ = serialization.serialize(x)
+    out = serialization.deserialize(blob)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_option_validation():
+    with pytest.raises(ValueError):
+        make_task_options(None, num_cpus=-1)
+    with pytest.raises(ValueError):
+        make_task_options(None, nope=1)
+    o = make_task_options(None, num_cpus=2, num_tpus=4)
+    assert o.num_cpus == 2 and o.num_tpus == 4
