@@ -1,0 +1,78 @@
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+
+North-star metric per BASELINE.json. Baseline constant: the reference's
+release gate is Torch DDP ResNet-50 per-GPU throughput on the A100-class
+hardware of its release tests (~2500 images/s/chip with AMP at batch 256;
+the repo publishes the harness, not absolute numbers — BASELINE.md). We
+report vs_baseline = ours / 2500.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 2500.0
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.train import make_resnet_train_step
+
+    n = jax.device_count()
+    mesh = build_mesh(MeshSpec(dp=n))
+    per_chip_batch = 256
+    batch_size = per_chip_batch * n
+    image_size = 224
+
+    init_fn, step_fn, place_batch = make_resnet_train_step(
+        mesh, num_classes=1000, image_size=image_size, learning_rate=0.1)
+    state = init_fn(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    batch = place_batch({
+        "image": jnp.asarray(
+            rng.normal(size=(batch_size, image_size, image_size, 3)),
+            jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 1000, (batch_size,)),
+                             jnp.int32),
+    })
+
+    # Warmup (compile), synced via a value that depends on the step output.
+    # Note: block_until_ready is unreliable on the tunneled axon platform;
+    # device_get of the final loss forces completion of the whole chain.
+    for _ in range(3):
+        state, metrics = step_fn(state, batch)
+    float(jax.device_get(metrics["loss"]))
+
+    steps = 30
+    best = float("inf")
+    for _ in range(2):  # two windows; keep the best (first may recompile)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch)
+        float(jax.device_get(metrics["loss"]))
+        best = min(best, time.perf_counter() - t0)
+    dt = best
+
+    img_per_sec = steps * batch_size / dt
+    per_chip = img_per_sec / n
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,590 @@
+// shmstore — per-node shared-memory object store daemon.
+//
+// Role parity: the reference's plasma store (reference
+// src/ray/object_manager/plasma/store.h:55, object_lifecycle_manager.h:101,
+// eviction_policy.h:160): create/seal/get/release/delete over a local
+// socket, zero-copy reads via shared memory, LRU eviction of unreferenced
+// sealed objects, spill-to-disk overflow. Design differences (deliberate,
+// TPU-host-oriented rather than a port):
+//   - one POSIX shm segment per object (kernel-managed allocation; clients
+//     mmap /dev/shm/<name> directly) instead of a dlmalloc arena + fd
+//     passing;
+//   - single-threaded epoll event loop, binary length-prefixed protocol;
+//   - eviction spills to a directory and GET transparently restores.
+//
+// Protocol (little-endian):
+//   request:  u32 payload_len | u8 op | 16B object id | op-specific
+//   response: u32 payload_len | u8 status | op-specific
+// Ops: CREATE(size u64) SEAL GET(timeout_ms i64) RELEASE DELETE CONTAINS
+//      STATS LIST
+// Build: g++ -O2 -std=c++17 -o shmstored shmstore.cc -lrt
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+#include <deque>
+#include <list>
+#include <map>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  OP_CREATE = 1,
+  OP_SEAL = 2,
+  OP_GET = 3,
+  OP_RELEASE = 4,
+  OP_DELETE = 5,
+  OP_CONTAINS = 6,
+  OP_STATS = 7,
+  OP_LIST = 8,
+};
+
+enum Status : uint8_t {
+  ST_OK = 0,
+  ST_NOT_FOUND = 1,
+  ST_EXISTS = 2,
+  ST_OOM = 3,
+  ST_TIMEOUT = 4,
+  ST_ERR = 5,
+  ST_NOT_SEALED = 6,
+};
+
+struct ObjectId {
+  char b[16];
+  bool operator==(const ObjectId& o) const { return !memcmp(b, o.b, 16); }
+};
+struct ObjectIdHash {
+  size_t operator()(const ObjectId& id) const {
+    size_t h;
+    memcpy(&h, id.b, sizeof(h));
+    return h;
+  }
+};
+
+std::string hex(const ObjectId& id) {
+  static const char* d = "0123456789abcdef";
+  std::string s;
+  s.reserve(32);
+  for (int i = 0; i < 16; i++) {
+    unsigned char c = id.b[i];
+    s += d[c >> 4];
+    s += d[c & 15];
+  }
+  return s;
+}
+
+enum ObjState { CREATED, SEALED, SPILLED };
+
+struct Object {
+  ObjState state = CREATED;
+  uint64_t size = 0;
+  int refcount = 0;  // sum of per-connection references
+  std::string shm_name;
+  uint64_t lru_tick = 0;
+  std::set<int> creators;  // fd that created (for cleanup on disconnect)
+};
+
+struct Waiter {
+  int fd;
+  ObjectId id;
+  int64_t deadline_ms;  // monotonic ms; -1 = forever
+};
+
+struct Conn {
+  int fd;
+  std::vector<uint8_t> inbuf;
+  std::deque<std::vector<uint8_t>> outq;
+  size_t out_off = 0;
+  // object -> per-connection refcount (released on disconnect)
+  std::unordered_map<ObjectId, int, ObjectIdHash> refs;
+};
+
+int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL;
+}
+
+class Store {
+ public:
+  Store(std::string prefix, uint64_t capacity, std::string spill_dir)
+      : prefix_(std::move(prefix)),
+        capacity_(capacity),
+        spill_dir_(std::move(spill_dir)) {}
+
+  uint64_t used_ = 0, spilled_bytes_ = 0, tick_ = 0;
+  uint64_t num_evictions_ = 0, num_spills_ = 0, num_restores_ = 0;
+
+  std::string shm_name_for(const ObjectId& id) const {
+    return "/" + prefix_ + hex(id);
+  }
+  std::string spill_path_for(const ObjectId& id) const {
+    return spill_dir_ + "/" + hex(id);
+  }
+
+  Status create(const ObjectId& id, uint64_t size, int fd) {
+    if (objects_.count(id)) return ST_EXISTS;
+    if (size > capacity_) return ST_OOM;
+    if (used_ + size > capacity_ && !evict(used_ + size - capacity_))
+      return ST_OOM;
+    std::string name = shm_name_for(id);
+    int sfd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (sfd < 0) return ST_ERR;
+    if (ftruncate(sfd, (off_t)size) != 0) {
+      close(sfd);
+      shm_unlink(name.c_str());
+      return ST_OOM;
+    }
+    close(sfd);
+    Object o;
+    o.size = size;
+    o.shm_name = name;
+    o.creators.insert(fd);
+    objects_[id] = std::move(o);
+    used_ += size;
+    return ST_OK;
+  }
+
+  Status seal(const ObjectId& id) {
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    if (it->second.state == SEALED) return ST_OK;
+    if (it->second.state != CREATED) return ST_ERR;
+    it->second.state = SEALED;
+    it->second.creators.clear();
+    it->second.lru_tick = ++tick_;
+    return ST_OK;
+  }
+
+  // GET: returns ST_OK (+size) when sealed & resident; restores spilled.
+  Status get(const ObjectId& id, uint64_t* size) {
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    Object& o = it->second;
+    if (o.state == CREATED) return ST_NOT_SEALED;
+    if (o.state == SPILLED && !restore(id, o)) return ST_ERR;
+    o.lru_tick = ++tick_;
+    *size = o.size;
+    return ST_OK;
+  }
+
+  Status del(const ObjectId& id) {
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    Object& o = it->second;
+    if (o.state == SPILLED) {
+      unlink(spill_path_for(id).c_str());
+      spilled_bytes_ -= o.size;
+    } else {
+      shm_unlink(o.shm_name.c_str());
+      used_ -= o.size;
+    }
+    objects_.erase(it);
+    return ST_OK;
+  }
+
+  bool contains(const ObjectId& id) {
+    auto it = objects_.find(id);
+    return it != objects_.end() && it->second.state != CREATED;
+  }
+
+  void add_ref(const ObjectId& id, int n) {
+    auto it = objects_.find(id);
+    if (it != objects_.end()) it->second.refcount += n;
+  }
+
+  // Evict LRU sealed, refcount==0 objects until `need` bytes are freed.
+  // Spills to disk if a spill dir is configured, else drops (objects are
+  // recoverable via lineage at the framework layer).
+  bool evict(uint64_t need) {
+    std::vector<std::pair<uint64_t, ObjectId>> cands;
+    for (auto& [id, o] : objects_)
+      if (o.state == SEALED && o.refcount == 0)
+        cands.push_back({o.lru_tick, id});
+    std::sort(cands.begin(), cands.end(),
+              [](auto& a, auto& b) { return a.first < b.first; });
+    uint64_t freed = 0;
+    for (auto& [_, id] : cands) {
+      if (freed >= need) break;
+      Object& o = objects_[id];
+      freed += o.size;
+      if (!spill_dir_.empty() && spill(id, o)) {
+        num_spills_++;
+      } else {
+        shm_unlink(o.shm_name.c_str());
+        used_ -= o.size;
+        objects_.erase(id);
+      }
+      num_evictions_++;
+    }
+    return freed >= need;
+  }
+
+  std::unordered_map<ObjectId, Object, ObjectIdHash> objects_;
+  std::string prefix_;
+  uint64_t capacity_;
+  std::string spill_dir_;
+
+ private:
+  bool spill(const ObjectId& id, Object& o) {
+    int sfd = shm_open(o.shm_name.c_str(), O_RDONLY, 0);
+    if (sfd < 0) return false;
+    void* p = mmap(nullptr, o.size, PROT_READ, MAP_SHARED, sfd, 0);
+    close(sfd);
+    if (p == MAP_FAILED) return false;
+    std::string path = spill_path_for(id);
+    int dfd = open(path.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0600);
+    if (dfd < 0) {
+      munmap(p, o.size);
+      return false;
+    }
+    uint64_t off = 0;
+    const char* src = (const char*)p;
+    bool ok = true;
+    while (off < o.size) {
+      ssize_t w = write(dfd, src + off, o.size - off);
+      if (w <= 0) {
+        ok = false;
+        break;
+      }
+      off += (uint64_t)w;
+    }
+    close(dfd);
+    munmap(p, o.size);
+    if (!ok) {
+      unlink(path.c_str());
+      return false;
+    }
+    shm_unlink(o.shm_name.c_str());
+    used_ -= o.size;
+    spilled_bytes_ += o.size;
+    o.state = SPILLED;
+    return true;
+  }
+
+  bool restore(const ObjectId& id, Object& o) {
+    if (used_ + o.size > capacity_ && !evict(used_ + o.size - capacity_))
+      return false;
+    std::string path = spill_path_for(id);
+    int dfd = open(path.c_str(), O_RDONLY);
+    if (dfd < 0) return false;
+    int sfd = shm_open(o.shm_name.c_str(), O_CREAT | O_RDWR, 0600);
+    if (sfd < 0 || ftruncate(sfd, (off_t)o.size) != 0) {
+      if (sfd >= 0) close(sfd);
+      close(dfd);
+      return false;
+    }
+    void* p = mmap(nullptr, o.size, PROT_WRITE, MAP_SHARED, sfd, 0);
+    close(sfd);
+    if (p == MAP_FAILED) {
+      close(dfd);
+      return false;
+    }
+    uint64_t off = 0;
+    char* dst = (char*)p;
+    bool ok = true;
+    while (off < o.size) {
+      ssize_t r = read(dfd, dst + off, o.size - off);
+      if (r <= 0) {
+        ok = false;
+        break;
+      }
+      off += (uint64_t)r;
+    }
+    close(dfd);
+    munmap(p, o.size);
+    if (!ok) return false;
+    unlink(path.c_str());
+    used_ += o.size;
+    spilled_bytes_ -= o.size;
+    o.state = SEALED;
+    num_restores_++;
+    return true;
+  }
+};
+
+class Server {
+ public:
+  Server(Store* store, const std::string& sock_path)
+      : store_(store), sock_path_(sock_path) {}
+
+  int run() {
+    signal(SIGPIPE, SIG_IGN);
+    listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (listen_fd_ < 0) return perror("socket"), 1;
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, sock_path_.c_str(), sizeof(addr.sun_path) - 1);
+    unlink(sock_path_.c_str());
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0)
+      return perror("bind"), 1;
+    if (listen(listen_fd_, 256) != 0) return perror("listen"), 1;
+    ep_ = epoll_create1(0);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd_;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    // readiness marker for the launcher
+    fprintf(stdout, "READY %s\n", sock_path_.c_str());
+    fflush(stdout);
+
+    std::vector<epoll_event> events(128);
+    for (;;) {
+      int timeout = waiters_.empty() ? 1000 : 50;
+      int n = epoll_wait(ep_, events.data(), (int)events.size(), timeout);
+      for (int i = 0; i < n; i++) {
+        int fd = events[i].data.fd;
+        if (fd == listen_fd_) {
+          accept_conns();
+        } else {
+          if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+            close_conn(fd);
+            continue;
+          }
+          if (events[i].events & EPOLLIN) handle_read(fd);
+          if (conns_.count(fd) && (events[i].events & EPOLLOUT))
+            flush_out(fd);
+        }
+      }
+      service_waiters();
+    }
+    return 0;
+  }
+
+ private:
+  void accept_conns() {
+    for (;;) {
+      int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_NONBLOCK);
+      if (fd < 0) break;
+      conns_[fd] = Conn{fd};
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      epoll_ctl(ep_, EPOLL_CTL_ADD, fd, &ev);
+    }
+  }
+
+  void close_conn(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    // release this connection's references; abort its unsealed creations
+    for (auto& [id, cnt] : it->second.refs) store_->add_ref(id, -cnt);
+    std::vector<ObjectId> to_del;
+    for (auto& [id, o] : store_->objects_)
+      if (o.state == CREATED && o.creators.count(fd)) to_del.push_back(id);
+    for (auto& id : to_del) store_->del(id);
+    epoll_ctl(ep_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    conns_.erase(it);
+    waiters_.remove_if([fd](const Waiter& w) { return w.fd == fd; });
+  }
+
+  void handle_read(int fd) {
+    Conn& c = conns_[fd];
+    char buf[65536];
+    for (;;) {
+      ssize_t r = recv(fd, buf, sizeof(buf), 0);
+      if (r > 0) {
+        c.inbuf.insert(c.inbuf.end(), buf, buf + r);
+      } else if (r == 0) {
+        close_conn(fd);
+        return;
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        close_conn(fd);
+        return;
+      }
+    }
+    // parse complete frames
+    size_t off = 0;
+    while (c.inbuf.size() - off >= 4) {
+      uint32_t len;
+      memcpy(&len, c.inbuf.data() + off, 4);
+      if (c.inbuf.size() - off - 4 < len) break;
+      handle_msg(fd, c.inbuf.data() + off + 4, len);
+      off += 4 + len;
+      if (!conns_.count(fd)) return;  // closed during handling
+    }
+    if (off) c.inbuf.erase(c.inbuf.begin(), c.inbuf.begin() + off);
+  }
+
+  void reply(int fd, uint8_t status, const void* extra = nullptr,
+             uint32_t extra_len = 0) {
+    std::vector<uint8_t> out(4 + 1 + extra_len);
+    uint32_t len = 1 + extra_len;
+    memcpy(out.data(), &len, 4);
+    out[4] = status;
+    if (extra_len) memcpy(out.data() + 5, extra, extra_len);
+    Conn& c = conns_[fd];
+    c.outq.push_back(std::move(out));
+    flush_out(fd);
+  }
+
+  void flush_out(int fd) {
+    Conn& c = conns_[fd];
+    while (!c.outq.empty()) {
+      auto& front = c.outq.front();
+      ssize_t w = send(fd, front.data() + c.out_off,
+                       front.size() - c.out_off, MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          epoll_event ev{};
+          ev.events = EPOLLIN | EPOLLOUT;
+          ev.data.fd = fd;
+          epoll_ctl(ep_, EPOLL_CTL_MOD, fd, &ev);
+          return;
+        }
+        close_conn(fd);
+        return;
+      }
+      c.out_off += (size_t)w;
+      if (c.out_off == front.size()) {
+        c.outq.pop_front();
+        c.out_off = 0;
+      }
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = fd;
+    epoll_ctl(ep_, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  void handle_msg(int fd, const uint8_t* p, uint32_t len) {
+    if (len < 1) return reply(fd, ST_ERR);
+    uint8_t op = p[0];
+    if (op == OP_STATS) {
+      char js[512];
+      int n = snprintf(js, sizeof(js),
+                       "{\"capacity\":%llu,\"used\":%llu,\"spilled\":%llu,"
+                       "\"objects\":%zu,\"evictions\":%llu,\"spills\":%llu,"
+                       "\"restores\":%llu}",
+                       (unsigned long long)store_->capacity_,
+                       (unsigned long long)store_->used_,
+                       (unsigned long long)store_->spilled_bytes_,
+                       store_->objects_.size(),
+                       (unsigned long long)store_->num_evictions_,
+                       (unsigned long long)store_->num_spills_,
+                       (unsigned long long)store_->num_restores_);
+      return reply(fd, ST_OK, js, (uint32_t)n);
+    }
+    if (op == OP_LIST) {
+      std::string out;
+      for (auto& [id, o] : store_->objects_)
+        if (o.state != CREATED) out.append(id.b, 16);
+      return reply(fd, ST_OK, out.data(), (uint32_t)out.size());
+    }
+    if (len < 17) return reply(fd, ST_ERR);
+    ObjectId id;
+    memcpy(id.b, p + 1, 16);
+    switch (op) {
+      case OP_CREATE: {
+        if (len < 25) return reply(fd, ST_ERR);
+        uint64_t size;
+        memcpy(&size, p + 17, 8);
+        Status st = store_->create(id, size, fd);
+        return reply(fd, st);
+      }
+      case OP_SEAL: {
+        Status st = store_->seal(id);
+        if (st == ST_OK) service_waiters();
+        return reply(fd, st);
+      }
+      case OP_GET: {
+        int64_t timeout_ms = 0;
+        if (len >= 25) memcpy(&timeout_ms, p + 17, 8);
+        uint64_t size;
+        Status st = store_->get(id, &size);
+        if (st == ST_OK) {
+          store_->add_ref(id, 1);
+          conns_[fd].refs[id]++;
+          return reply(fd, ST_OK, &size, 8);
+        }
+        if ((st == ST_NOT_FOUND || st == ST_NOT_SEALED) && timeout_ms != 0) {
+          int64_t dl = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+          waiters_.push_back({fd, id, dl});
+          return;  // deferred reply
+        }
+        return reply(fd, st);
+      }
+      case OP_RELEASE: {
+        auto& refs = conns_[fd].refs;
+        auto rit = refs.find(id);
+        if (rit != refs.end() && rit->second > 0) {
+          rit->second--;
+          store_->add_ref(id, -1);
+          if (!rit->second) refs.erase(rit);
+        }
+        return reply(fd, ST_OK);
+      }
+      case OP_DELETE:
+        return reply(fd, store_->del(id));
+      case OP_CONTAINS:
+        return reply(fd, store_->contains(id) ? ST_OK : ST_NOT_FOUND);
+      default:
+        return reply(fd, ST_ERR);
+    }
+  }
+
+  void service_waiters() {
+    int64_t now = now_ms();
+    for (auto it = waiters_.begin(); it != waiters_.end();) {
+      uint64_t size;
+      Status st = store_->get(it->id, &size);
+      if (st == ST_OK) {
+        if (conns_.count(it->fd)) {
+          store_->add_ref(it->id, 1);
+          conns_[it->fd].refs[it->id]++;
+          reply(it->fd, ST_OK, &size, 8);
+        }
+        it = waiters_.erase(it);
+      } else if (it->deadline_ms >= 0 && now >= it->deadline_ms) {
+        if (conns_.count(it->fd)) reply(it->fd, ST_TIMEOUT);
+        it = waiters_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  Store* store_;
+  std::string sock_path_;
+  int listen_fd_ = -1, ep_ = -1;
+  std::unordered_map<int, Conn> conns_;
+  std::list<Waiter> waiters_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  // usage: shmstored <socket_path> <capacity_bytes> <shm_prefix> [spill_dir]
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <socket> <capacity_bytes> <shm_prefix> [spill_dir]\n",
+            argv[0]);
+    return 2;
+  }
+  std::string spill_dir = argc > 4 ? argv[4] : "";
+  if (!spill_dir.empty()) mkdir(spill_dir.c_str(), 0700);
+  Store store(argv[3], strtoull(argv[2], nullptr, 10), spill_dir);
+  Server srv(&store, argv[1]);
+  return srv.run();
+}

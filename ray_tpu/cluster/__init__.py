@@ -1,0 +1,14 @@
+"""Distributed cluster runtime.
+
+Process anatomy (role parity in parentheses, per SURVEY.md §1):
+  conductor    — cluster control plane (GCS, src/ray/gcs): node/actor/PG/job
+                 tables, KV, named actors, pubsub, health checks.
+  node daemon  — per-node manager (raylet, src/ray/raylet): worker pool,
+                 local lease scheduler, object-store supervision, spillback.
+  shmstored    — C++ shared-memory object store (plasma), native/shmstore/.
+  workers      — task/actor executor processes (core worker + default_worker).
+
+Control RPCs are msgpack-framed asyncio TCP (protocol.py); bulk objects move
+through shared memory locally and chunked TCP between nodes (transfer in the
+node daemon).
+"""

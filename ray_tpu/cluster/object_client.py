@@ -1,0 +1,178 @@
+"""Client for the shmstore daemon (native/shmstore/shmstore.cc).
+
+Zero-copy reads: the daemon backs each object with a POSIX shm segment; the
+client mmaps /dev/shm/<prefix><oid> directly and hands out memoryviews, so a
+100 GiB numpy array is never copied through a socket (parity with the
+reference's plasma get path, reference core_worker.cc:1307 -> plasma mmap).
+
+Thread-safe: one lock around the request/response socket; data-plane reads
+go straight to shared memory without holding it.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import socket
+import struct
+import subprocess
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_STATS, \
+    OP_LIST = range(1, 9)
+ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_OOM, ST_TIMEOUT, ST_ERR, ST_NOT_SEALED = \
+    range(7)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "_native")
+SHMSTORED = os.path.join(_NATIVE_DIR, "shmstored")
+
+
+class ObjectStoreError(Exception):
+    pass
+
+
+class ObjectStoreFullError(ObjectStoreError):
+    pass
+
+
+def ensure_built() -> str:
+    """Build the daemon from source if the binary is missing."""
+    if not os.path.exists(SHMSTORED):
+        src_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "native")
+        subprocess.run(["make", "-C", src_dir], check=True,
+                       capture_output=True)
+    return SHMSTORED
+
+
+def start_store(sock_path: str, capacity: int, prefix: str,
+                spill_dir: Optional[str] = None) -> subprocess.Popen:
+    """Launch shmstored; waits for its READY line."""
+    ensure_built()
+    args = [SHMSTORED, sock_path, str(capacity), prefix]
+    if spill_dir:
+        args.append(spill_dir)
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("READY"):
+        proc.kill()
+        raise ObjectStoreError(f"shmstored failed to start: {line!r}")
+    return proc
+
+
+class ShmClient:
+    """Connection to one node's shmstored."""
+
+    def __init__(self, sock_path: str, prefix: str):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(sock_path)
+        self._prefix = prefix
+        self._lock = threading.Lock()
+        self._maps: Dict[bytes, Tuple[mmap.mmap, int]] = {}
+
+    # --- framing ---------------------------------------------------------
+    def _call(self, payload: bytes) -> bytes:
+        with self._lock:
+            self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+            return self._read_frame()
+
+    def _read_frame(self) -> bytes:
+        hdr = self._recv_exact(4)
+        (length,) = struct.unpack("<I", hdr)
+        return self._recv_exact(length)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ObjectStoreError("store connection closed")
+            buf += chunk
+        return buf
+
+    # --- object ops ------------------------------------------------------
+    def _shm_path(self, oid: bytes) -> str:
+        return f"/dev/shm/{self._prefix}{oid.hex()}"
+
+    def create(self, oid: bytes, size: int) -> memoryview:
+        """Reserve an object and return a writable view; seal() when done."""
+        resp = self._call(struct.pack("<B16sQ", OP_CREATE, oid, size))
+        st = resp[0]
+        if st == ST_OOM:
+            raise ObjectStoreFullError(f"object of {size} bytes doesn't fit")
+        if st == ST_EXISTS:
+            raise ObjectStoreError(f"object {oid.hex()} already exists")
+        if st != ST_OK:
+            raise ObjectStoreError(f"create failed: status {st}")
+        fd = os.open(self._shm_path(oid), os.O_RDWR)
+        try:
+            mm = mmap.mmap(fd, size) if size else mmap.mmap(-1, 1)
+        finally:
+            os.close(fd)
+        return memoryview(mm)[:size] if size else memoryview(b"")
+
+    def seal(self, oid: bytes) -> None:
+        resp = self._call(struct.pack("<B16s", OP_SEAL, oid))
+        if resp[0] != ST_OK:
+            raise ObjectStoreError(f"seal failed: status {resp[0]}")
+
+    def put(self, oid: bytes, data) -> None:
+        data = memoryview(data)
+        buf = self.create(oid, data.nbytes)
+        buf[:] = data.cast("B") if data.format != "B" else data
+        self.seal(oid)
+
+    def get(self, oid: bytes, timeout: Optional[float] = None
+            ) -> Optional[memoryview]:
+        """Blocking get -> zero-copy readonly view, or None on timeout."""
+        timeout_ms = -1 if timeout is None else int(timeout * 1000)
+        resp = self._call(struct.pack("<B16sq", OP_GET, oid, timeout_ms))
+        st = resp[0]
+        if st == ST_TIMEOUT:
+            return None
+        if st != ST_OK:
+            raise ObjectStoreError(f"get failed: status {st}")
+        (size,) = struct.unpack("<Q", resp[1:9])
+        if size == 0:
+            return memoryview(b"")
+        fd = os.open(self._shm_path(oid), os.O_RDONLY)
+        try:
+            mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        self._maps[oid] = (mm, size)
+        return memoryview(mm)
+
+    def release(self, oid: bytes) -> None:
+        mm = self._maps.pop(oid, None)
+        self._call(struct.pack("<B16s", OP_RELEASE, oid))
+        # the mmap view may still be referenced by user numpy arrays; let GC
+        # close it (mmap keeps the pages alive independently of the store)
+
+    def delete(self, oid: bytes) -> None:
+        self._call(struct.pack("<B16s", OP_DELETE, oid))
+
+    def contains(self, oid: bytes) -> bool:
+        resp = self._call(struct.pack("<B16s", OP_CONTAINS, oid))
+        return resp[0] == ST_OK
+
+    def stats(self) -> dict:
+        import json
+        resp = self._call(struct.pack("<B", OP_STATS))
+        return json.loads(resp[1:].decode())
+
+    def list_objects(self) -> List[bytes]:
+        resp = self._call(struct.pack("<B", OP_LIST))
+        body = resp[1:]
+        return [bytes(body[i:i + 16]) for i in range(0, len(body), 16)]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -8,7 +8,6 @@ wrapper so they cannot be mixed up.
 from __future__ import annotations
 
 import os
-import threading
 
 
 class BaseID:
@@ -61,9 +60,6 @@ class ObjectID(BaseID):
 
 class TaskID(BaseID):
     SIZE = 16
-
-    _counter_lock = threading.Lock()
-    _counter = 0
 
     def object_id_for_return(self, index: int) -> ObjectID:
         """Deterministically derive the i-th return ObjectID of this task."""

@@ -209,7 +209,7 @@ class LocalRuntime:
                     self._named_actors[key] = actor_id
             if existing is not None:
                 if opts.get_if_exists:
-                    st = self._actors[existing]
+                    st = self._await_actor_state(existing)
                     return ActorHandle(existing, desc.repr_name(), st.methods,
                                        st.is_async)
                 raise ValueError(f"Actor name {opts.name!r} already taken in "
@@ -229,13 +229,30 @@ class LocalRuntime:
             self._actors[actor_id] = state
         return ActorHandle(actor_id, desc.repr_name(), methods, is_async)
 
+    def _await_actor_state(self, actor_id: ActorID,
+                           timeout: float = 30.0) -> _ActorState:
+        """Wait for a reserved-but-still-constructing actor to appear.
+
+        The name is reserved in _named_actors before the user __init__ runs,
+        so a concurrent lookup can observe the reservation before the state
+        is inserted into _actors.
+        """
+        deadline = time.monotonic() + timeout
+        while True:
+            st = self._actors.get(actor_id)
+            if st is not None:
+                return st
+            if time.monotonic() >= deadline:
+                raise ValueError("actor is still being constructed")
+            time.sleep(0.001)
+
     def get_actor(self, name: str, namespace: str = "") -> ActorHandle:
         key = (namespace or "default", name)
         with self._lock:
             actor_id = self._named_actors.get(key)
             if actor_id is None:
                 raise ValueError(f"No actor named {name!r} in namespace {key[0]!r}")
-            st = self._actors[actor_id]
+        st = self._await_actor_state(actor_id)
         return ActorHandle(actor_id, type(st.instance).__name__, st.methods,
                            st.is_async)
 
@@ -295,10 +312,30 @@ class LocalRuntime:
                     task_id, num_returns, e,
                     f"{handle._rt_class_name}.{method_name}"))
 
-        if state.is_async:
-            asyncio.run_coroutine_threadsafe(run_async(), state.loop)
-        else:
-            state.pool.submit(run_sync)
+        try:
+            if state.is_async:
+                asyncio.run_coroutine_threadsafe(run_async(), state.loop)
+            else:
+                state.pool.submit(run_sync)
+        except RuntimeError:
+            # pool/loop shut down by a concurrent kill()
+            state.dead = True
+        # kill() may have drained pending_returns between our registration
+        # and scheduling; make sure these refs resolve either way.
+        if state.dead:
+            err = TaskError.from_exception(
+                ActorDiedError(handle._rt_class_name,
+                               state.death_reason or "killed"))
+            with state.pending_lock:
+                for r in refs:
+                    state.pending_returns.discard(r.id)
+            for r in refs:
+                fut = self._future_for(r.id)
+                if not fut.done():
+                    try:
+                        fut.set_result(err)
+                    except futures.InvalidStateError:
+                        pass
         return refs
 
     def _fail_returns(self, task_id, num_returns, exc, desc):

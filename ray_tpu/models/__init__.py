@@ -1,0 +1,28 @@
+"""Model zoo, TPU-first.
+
+Flagship: decoder-only Transformer LM (llama-style: RMSNorm / SwiGLU / RoPE /
+GQA, optional MoE), pure-functional params pytree with logical-axis
+annotations so one definition runs under any MeshSpec (dp/fsdp/tp/pp/sp/ep).
+Plus ResNet-50 (the north-star image benchmark, BASELINE.json) and an MLP.
+
+Role parity: the reference's model code lives in RLlib's catalog (reference
+rllib/models/catalog.py:197) and in user-provided torch modules for
+ray.train; here models are jax pytrees + pure apply fns, jit/pjit-ready.
+"""
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    transformer_init,
+    transformer_apply,
+    transformer_loss,
+    transformer_logical_axes,
+)
+from ray_tpu.models.resnet import resnet50_init, resnet50_apply, resnet_loss
+from ray_tpu.models.mlp import mlp_init, mlp_apply
+
+__all__ = [
+    "TransformerConfig", "transformer_init", "transformer_apply",
+    "transformer_loss", "transformer_logical_axes",
+    "resnet50_init", "resnet50_apply", "resnet_loss",
+    "mlp_init", "mlp_apply",
+]

@@ -1,0 +1,26 @@
+"""Tiny MLP — unit-test / CartPole-policy workhorse."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def mlp_init(key, sizes: Sequence[int], dtype=jnp.float32):
+    """sizes: [in, hidden..., out]."""
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (a, b) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (a, b), dtype) * jnp.sqrt(2.0 / a)
+        params.append({"w": w, "b": jnp.zeros((b,), dtype)})
+    return params
+
+
+def mlp_apply(params, x, activation=jax.nn.relu):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = activation(x)
+    return x

@@ -1,0 +1,66 @@
+"""Mixture-of-Experts layer with expert parallelism (GShard/Switch style).
+
+Capacity-based top-k routing with einsum dispatch/combine tensors — the
+XLA-friendly formulation: no dynamic shapes, tokens over capacity are
+dropped (residual path keeps them). Expert weights carry the "expert"
+logical axis -> "ep" mesh axis (parallel/sharding.py DEFAULT_RULES), so
+pjit turns the expert einsums into all-to-all dispatch over ICI.
+
+Expert parallelism is absent from the reference (SURVEY.md §2d row EP).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_apply(cfg, moe_params, h, *, capacity_factor: float = 1.25):
+    """h: [B, S, D] -> [B, S, D]. Top-k capacity routing per batch row."""
+    dt = h.dtype
+    b, s, d = h.shape
+    e = cfg.num_experts
+    k = cfg.expert_top_k
+    cap = max(1, int(capacity_factor * s * k / e))
+
+    logits = jnp.einsum("bsd,de->bse", h, moe_params["router"].astype(dt))
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # iterative top-k: take the best expert, mask it out, repeat
+    dispatch = jnp.zeros((b, s, e, cap), jnp.float32)
+    combine = jnp.zeros((b, s, e, cap), jnp.float32)
+    remaining = gates
+    used = jnp.zeros((b, e), jnp.int32)  # slots taken per expert
+    for _ in range(k):
+        gate_val = remaining.max(axis=-1)                     # [B,S]
+        idx = remaining.argmax(axis=-1)                       # [B,S]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # [B,S,E]
+        # position of each token within its expert's capacity buffer
+        pos = jnp.cumsum(onehot, axis=1) - 1 + used[:, None, :]
+        pos_tok = jnp.take_along_axis(pos, idx[..., None], -1)[..., 0]
+        pos_tok = pos_tok.astype(jnp.int32)
+        keep = pos_tok < cap
+        gv = jnp.where(keep, gate_val, 0.0)
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos_tok, cap), cap,
+                                dtype=jnp.float32)            # [B,S,C]
+        slot = onehot[..., None] * pos_oh[:, :, None, :]       # [B,S,E,C]
+        dispatch = dispatch + slot
+        combine = combine + slot * gv[..., None, None]
+        used = used + (onehot * keep[..., None]).sum(1).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+
+    xs = jnp.einsum("bsec,bsd->becd", dispatch.astype(dt), h)  # [B,E,C,D]
+    w1, w3, w2 = (moe_params[n].astype(dt) for n in ("w1", "w3", "w2"))
+    gate = jax.nn.silu(jnp.einsum("becd,edf->becf", xs, w1))
+    up = jnp.einsum("becd,edf->becf", xs, w3)
+    ys = jnp.einsum("becf,efd->becd", gate * up, w2)           # [B,E,C,D]
+    return jnp.einsum("bsec,becd->bsd", combine.astype(dt), ys)
+
+
+def load_balance_loss(gates, dispatch):
+    """Switch-style auxiliary loss: encourages uniform expert load.
+    gates: [B,S,E] softmax probs; dispatch: [B,S,E,C]."""
+    e = gates.shape[-1]
+    frac_tokens = dispatch.sum((1, 3)) / jnp.maximum(dispatch.sum((1, 2, 3,))[:, None], 1)
+    frac_probs = gates.mean(1)
+    return e * (frac_tokens * frac_probs).sum(-1).mean()

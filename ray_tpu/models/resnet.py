@@ -1,0 +1,89 @@
+"""ResNet-50 (flax.linen) — the image-classification bench model.
+
+North-star metric per BASELINE.json: images/sec/chip training ResNet-50.
+Conv/matmul compute in bfloat16 (MXU), batch-norm statistics in float32.
+Data layout NHWC (TPU-native).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), self.strides)(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), self.strides,
+                            name="conv_proj")(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(64 * 2 ** i, strides, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet([3, 4, 6, 3], num_classes, dtype)
+
+
+def resnet50_init(key, *, image_size: int = 224, num_classes: int = 1000,
+                  dtype=jnp.bfloat16):
+    model = resnet50(num_classes, dtype)
+    variables = model.init(key, jnp.zeros((1, image_size, image_size, 3),
+                                          jnp.float32), train=True)
+    return model, variables
+
+
+def resnet50_apply(model: ResNet, variables, images, *, train: bool = True):
+    """Returns (logits, new_batch_stats) in train mode, logits otherwise."""
+    if train:
+        logits, updates = model.apply(variables, images, train=True,
+                                      mutable=["batch_stats"])
+        return logits, updates["batch_stats"]
+    return model.apply(variables, images, train=False)
+
+
+def resnet_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return nll.mean()

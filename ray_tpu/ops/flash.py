@@ -1,0 +1,332 @@
+"""Fused flash-attention Pallas kernels for TPU (forward + backward).
+
+FlashAttention-2 style: the kv-block loop is the innermost (sequential) grid
+dimension, with the running max / denominator / accumulator living in VMEM
+scratch that persists across that dimension; softmax is never materialized
+in HBM. Backward recomputes probabilities blockwise from the saved
+log-sum-exp and accumulates dq / dk / dv in scratch.
+
+MXU notes: matmuls via dot_general with preferred_element_type=float32;
+block sizes default to 128 (MXU tile); causal blocks entirely above the
+diagonal are skipped with pl.when.
+
+Differentiable via jax.custom_vjp. CPU/interpret fallback goes through
+ops/attention.py blockwise (same math), so callers can use one entry point
+everywhere (ops/attention.py mha(impl="auto")).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _dot(a, b):
+    return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def _dot_t(a, b):
+    """a @ b.T"""
+    return lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                q_offset):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = True
+    if causal:
+        # the block's first q row vs its last k column decides relevance
+        run = (qi * block_q + q_offset + block_q - 1) >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = _dot_t(q, k) * scale                      # [Bq, Bk] f32
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (rows + qi * block_q + q_offset) >= (cols + ki * block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+        m_safe = jnp.maximum(m_new, NEG_INF / 2)
+        p = jnp.exp(s - m_safe)
+        corr = jnp.exp(m_prev - m_safe)
+        l_ref[:] = l_ref[:] * corr + p.sum(-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + _dot(
+            p.astype(v_ref.dtype), v_ref[0])
+        m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # lse broadcast across the 128-lane dim (TPU block alignment)
+        lse_ref[0] = jnp.broadcast_to(m_ref[:] + jnp.log(l),
+                                      lse_ref.shape[1:])
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
+    """q,k,v: [BH, S, D] -> (out [BH, Sq, D], lse [BH, Sq])."""
+    bh, sq, d = q.shape
+    _, sk, _ = k.shape
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    assert sq % bq == 0 and sk % bk == 0
+    grid = (bh, sq // bq, sk // bk)
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        q_offset=sk - sq)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, q_offset):
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (qi * block_q + q_offset + block_q - 1) >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _dot_t(q, k) * scale                      # [Bq, Bk]
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (rows + qi * block_q + q_offset) >= (cols + ki * block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])          # [Bq, Bk]
+        dv_acc[:] += _dot(p.T, do)                    # [Bk, D]
+        dp = _dot_t(do, v)                            # [Bq, Bk]
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_acc[:] += _dot(ds.T, q)                    # [Bk, D]
+
+    @pl.when(qi == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                   q_offset):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = (qi * block_q + q_offset + block_q - 1) >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _dot_t(q, k) * scale
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (rows + qi * block_q + q_offset) >= (cols + ki * block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = _dot_t(do, v)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_acc[:] += _dot(ds, k)
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(res, g, *, causal, scale, block_q, block_k):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    _, sk, _ = k.shape
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    q_offset = sk - sq
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), -1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
+
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, q_offset=q_offset),
+        grid=(bh, sk // bk, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),   # do
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+    )(q, k, v, g, lse, delta)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, q_offset=q_offset),
+        grid=(bh, sq // bq, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                        block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhsd_bwd(causal, scale, block_q, block_k, res, g):
+    return _flash_bwd(res, g, causal=causal, scale=scale,
+                      block_q=block_q, block_k=block_k)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused attention; q,k,v: [B, S, H, D] -> [B, Sq, H, D].
+
+    Requires Sq % block_q == 0 and Sk % block_k == 0 (after clamping to the
+    sequence length). Off-TPU backends fall back to the blockwise scan form
+    (identical math).
+    """
+    if not _pallas_supported():
+        from ray_tpu.ops.attention import blockwise_attention
+        return blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                   block_size=block_k)
+    b, sq, h, d = q.shape
+    _, sk, hk, _ = k.shape
+    if hk != h:
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale_ = scale if scale is not None else d ** -0.5
+
+    def to_bhsd(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash_bhsd(to_bhsd(q, sq), to_bhsd(k, sk), to_bhsd(v, sk),
+                      causal, scale_, block_q, block_k)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.cache
+def _pallas_supported() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+# deferred import so the module can be read top-down; pallas only needed on
+# the TPU path
+try:  # pragma: no cover - import guard
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pallas unavailable -> fallback path only
+    pl = None
+    pltpu = None

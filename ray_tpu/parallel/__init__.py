@@ -1,0 +1,39 @@
+"""Parallelism strategies, TPU-first.
+
+The reference ships DP/FSDP via torch process groups (reference
+python/ray/train/torch/config.py:113, train_loop_utils.py:23-96) and has no
+in-tree TP/PP/SP/EP (SURVEY.md §2d). Here every strategy is an axis of one
+`jax.sharding.Mesh`:
+
+    dp    data parallel          (batch sharded, grads psum'd by XLA)
+    fsdp  sharded data parallel  (batch + params/optimizer sharded, ZeRO-3)
+    tp    tensor parallel        (weight matrices sharded within a layer)
+    pp    pipeline parallel      (layer stages; microbatched shard_map loop)
+    sp    sequence/context par.  (ring attention / Ulysses over ICI)
+    ep    expert parallel        (MoE experts sharded)
+
+Shardings are expressed as logical-axis rules mapped onto mesh axes
+(`LogicalRules`), compiled by pjit/GSPMD; collectives ride ICI.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+    mesh_shape_for,
+)
+from ray_tpu.parallel.sharding import (
+    LogicalRules,
+    DEFAULT_RULES,
+    logical_sharding,
+    shard_pytree,
+    with_sharding,
+    batch_sharding,
+    replicated,
+)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "local_mesh", "mesh_shape_for",
+    "LogicalRules", "DEFAULT_RULES", "logical_sharding", "shard_pytree",
+    "with_sharding", "batch_sharding", "replicated",
+]

@@ -1,0 +1,73 @@
+"""In-program collectives: thin, named wrappers over XLA collectives.
+
+The reference's data-plane collectives are NCCL/GLOO groups driven from
+Python per-op (reference python/ray/util/collective/collective.py:258-640);
+on TPU the equivalents are *compiled into the step function* and ride ICI.
+These helpers are meant for use inside `shard_map`-ped functions where mesh
+axes are visible as named axes. The host-level, actor-to-actor collective
+API with the reference's signatures lives in ray_tpu.util.collective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def allreduce_sum(x, axis: AxisName):
+    return lax.psum(x, axis_name=axis)
+
+
+def allreduce_mean(x, axis: AxisName):
+    return lax.pmean(x, axis_name=axis)
+
+
+def allreduce_max(x, axis: AxisName):
+    return lax.pmax(x, axis_name=axis)
+
+
+def allreduce_min(x, axis: AxisName):
+    return lax.pmin(x, axis_name=axis)
+
+
+def allgather(x, axis: AxisName, *, concat_dim: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name=axis, axis=concat_dim, tiled=tiled)
+
+
+def reducescatter(x, axis: AxisName, *, scatter_dim: int = 0):
+    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_dim,
+                            tiled=True)
+
+
+def alltoall(x, axis: AxisName, *, split_dim: int, concat_dim: int):
+    return lax.all_to_all(x, axis_name=axis, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=True)
+
+
+def ring_permute(x, axis: str, *, shift: int = 1):
+    """Send to (i+shift) mod n along `axis` — the ICI-neighbor hop used by
+    ring attention and pipeline stages."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def broadcast_from(x, axis: str, *, root: int = 0):
+    """Every member gets root's value (select-and-psum, compiles to an ICI
+    broadcast)."""
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name=axis)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)

@@ -1,0 +1,102 @@
+"""Device-mesh construction over TPU slices.
+
+One `jax.sharding.Mesh` with named axes ("dp","fsdp","tp","pp","sp","ep") is
+the substrate of every parallelism strategy. The reference's analog is the
+torch process-group bootstrap (reference python/ray/train/torch/config.py:113
+dist.init_process_group); here there is no rendezvous per-strategy — you pick
+axis sizes once and XLA compiles the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+# tp innermost: tensor-parallel collectives are per-layer and latency-bound,
+# so tp must map to the fastest (most-adjacent) ICI dimension. pp outermost:
+# stage-to-stage transfers happen once per microbatch.
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Axis sizes for the global device mesh. 1 = strategy off."""
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.pp * self.sp * self.ep
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def active_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a in AXIS_ORDER if getattr(self, a) > 1)
+
+    @staticmethod
+    def auto(num_devices: int, *, tp: int = 1, pp: int = 1, sp: int = 1,
+             ep: int = 1, fsdp: Optional[int] = None) -> "MeshSpec":
+        """Fill the remaining devices with (fsdp or dp) parallelism."""
+        model = tp * pp * sp * ep
+        if num_devices % model:
+            raise ValueError(
+                f"tp*pp*sp*ep={model} does not divide num_devices={num_devices}")
+        rest = num_devices // model
+        if fsdp is None:
+            return MeshSpec(dp=rest, tp=tp, pp=pp, sp=sp, ep=ep)
+        if rest % fsdp:
+            raise ValueError(f"fsdp={fsdp} does not divide remainder {rest}")
+        return MeshSpec(dp=rest // fsdp, fsdp=fsdp, tp=tp, pp=pp, sp=sp, ep=ep)
+
+
+def mesh_shape_for(spec: MeshSpec) -> Tuple[Tuple[str, int], ...]:
+    return tuple((a, getattr(spec, a)) for a in AXIS_ORDER)
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a jax Mesh with the spec's axes over `devices`.
+
+    Device order respects ICI adjacency: jax returns devices in topology
+    order, and we reshape row-major so the innermost axis (tp) maps to
+    adjacent chips.
+    """
+    import jax
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if spec.num_devices > len(devs):
+        raise ValueError(
+            f"MeshSpec needs {spec.num_devices} devices, have {len(devs)}")
+    devs = devs[: spec.num_devices]
+    shape = [getattr(spec, a) for a in AXIS_ORDER]
+    arr = np.array(devs, dtype=object).reshape(shape)
+    return jax.sharding.Mesh(arr, AXIS_ORDER)
+
+
+def local_mesh(**axis_sizes):
+    """Convenience: build_mesh(MeshSpec(**axis_sizes)) on all local devices."""
+    return build_mesh(MeshSpec(**axis_sizes))
+
+
+def data_axes() -> Tuple[str, ...]:
+    """Mesh axes a per-example batch dimension is sharded over."""
+    return ("dp", "fsdp")
+
+
+def best_dp_fsdp_split(num_devices: int, params_bytes: int,
+                       hbm_bytes_per_chip: int = 16 << 30) -> MeshSpec:
+    """Heuristic: use pure DP until replicated params+opt-state (~4x params
+    for adam in f32 master) would not fit; then shard with fsdp."""
+    need = params_bytes * 4
+    if need <= hbm_bytes_per_chip // 2:
+        return MeshSpec(dp=num_devices)
+    fsdp = 1
+    while fsdp < num_devices and need // fsdp > hbm_bytes_per_chip // 2:
+        fsdp *= 2
+    return MeshSpec(dp=num_devices // fsdp, fsdp=fsdp)

@@ -1,0 +1,178 @@
+"""TPU topology discovery and the slice model.
+
+A *slice* is the unit of gang scheduling: an ICI-connected set of chips that
+one XLA program can address (v4-8, v5e-16, ...). The scheduler treats a slice
+request as a placement-group whose bundles must land on the hosts of one
+contiguous slice (SURVEY.md §7 phase 4); this module is the pure-data side:
+what topologies exist, how many chips per host, and which jax devices belong
+to the local process.
+
+Known-generation table follows public TPU system documentation; detection is
+best-effort from jax.devices() and TPU env vars, and degrades cleanly to CPU
+(for the virtual-device test mesh, tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+# chips-per-host for each generation's standard host form factor.
+_CHIPS_PER_HOST: Dict[str, int] = {
+    "v2": 4,
+    "v3": 4,
+    "v4": 4,
+    "v5e": 8,
+    "v5p": 4,
+    "v6e": 8,
+    "cpu": 8,  # virtual CPU "slice" used by tests
+}
+
+# ICI mesh shapes for common slice sizes (chips -> (x, y) or (x, y, z)).
+# v4/v5p are 3D tori; v2/v3/v5e/v6e are 2D meshes.
+_MESH_2D: Dict[int, Tuple[int, int]] = {
+    1: (1, 1), 2: (1, 2), 4: (2, 2), 8: (2, 4), 16: (4, 4),
+    32: (4, 8), 64: (8, 8), 128: (8, 16), 256: (16, 16),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceSpec:
+    """A requested or discovered TPU slice.
+
+    accelerator_type follows the cloud naming, e.g. "v5e-16" = 16 v5e chips.
+    """
+    generation: str          # "v4", "v5e", ...
+    num_chips: int
+    topology: Tuple[int, ...]  # ICI mesh/torus shape
+
+    @property
+    def accelerator_type(self) -> str:
+        return f"{self.generation}-{self.num_chips}"
+
+    @property
+    def num_hosts(self) -> int:
+        per = _CHIPS_PER_HOST.get(self.generation, 4)
+        return max(1, math.ceil(self.num_chips / per))
+
+    @property
+    def chips_per_host(self) -> int:
+        return min(self.num_chips, _CHIPS_PER_HOST.get(self.generation, 4))
+
+    @staticmethod
+    def parse(accelerator_type: str) -> "SliceSpec":
+        """Parse "v5e-16" / "v4-8" style names."""
+        m = re.fullmatch(r"(v\d+[a-z]*)-(\d+)", accelerator_type)
+        if not m:
+            raise ValueError(
+                f"Bad accelerator type {accelerator_type!r}; expected e.g. 'v5e-16'")
+        gen, n = m.group(1), int(m.group(2))
+        return SliceSpec(gen, n, slice_mesh_shape(gen, n))
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuTopology:
+    """The local process's view of its accelerator devices."""
+    platform: str            # "tpu" or "cpu"
+    device_kind: str         # e.g. "TPU v5 lite", or "cpu"
+    generation: str
+    num_local_devices: int
+    num_global_devices: int
+    process_index: int
+    num_processes: int
+
+    @property
+    def slice_spec(self) -> SliceSpec:
+        return SliceSpec(self.generation, self.num_global_devices,
+                         slice_mesh_shape(self.generation,
+                                          self.num_global_devices))
+
+
+def slice_mesh_shape(generation: str, num_chips: int) -> Tuple[int, ...]:
+    """ICI mesh shape for a slice of `num_chips` chips."""
+    if generation in ("v4", "v5p"):
+        # 3D torus: factor into the most-cubic shape of multiples of 4 where
+        # possible; fall back to (1,1,n).
+        best = (1, 1, num_chips)
+        best_cost = num_chips + 2
+        for x in range(1, int(round(num_chips ** (1 / 3))) + 2):
+            if num_chips % x:
+                continue
+            rem = num_chips // x
+            for y in range(x, int(math.isqrt(rem)) + 1):
+                if rem % y:
+                    continue
+                z = rem // y
+                cost = x + y + z
+                if cost < best_cost:
+                    best, best_cost = (x, y, z), cost
+        return best
+    shape = _MESH_2D.get(num_chips)
+    if shape is None:
+        # non-standard size: nearly-square 2D factorization
+        x = max(d for d in range(1, int(math.isqrt(num_chips)) + 1)
+                if num_chips % d == 0)
+        shape = (x, num_chips // x)
+    return shape
+
+
+def _generation_from_kind(kind: str) -> str:
+    kind = kind.lower()
+    for gen, pat in [("v6e", "v6"), ("v5p", "v5p"),
+                     ("v5e", "v5 lite"), ("v5e", "v5e"), ("v5p", "v5"),
+                     ("v4", "v4"), ("v3", "v3"), ("v2", "v2")]:
+        if pat in kind:
+            return gen
+    return "cpu" if "cpu" in kind else "unknown"
+
+
+def device_kind() -> str:
+    import jax
+    devs = jax.devices()
+    return devs[0].device_kind if devs else "none"
+
+
+def local_chip_count() -> int:
+    import jax
+    return jax.local_device_count()
+
+
+def detect_topology() -> TpuTopology:
+    """Inspect jax for the local accelerator topology.
+
+    Works on real TPU and on the virtual CPU mesh used in tests.
+    """
+    import jax
+    devs = jax.devices()
+    platform = devs[0].platform if devs else "cpu"
+    kind = devs[0].device_kind if devs else "cpu"
+    gen = _generation_from_kind(kind)
+    if gen in ("cpu", "unknown") and platform not in ("tpu",):
+        gen = "cpu"
+    return TpuTopology(
+        platform=platform,
+        device_kind=kind,
+        generation=gen,
+        num_local_devices=jax.local_device_count(),
+        num_global_devices=jax.device_count(),
+        process_index=jax.process_index(),
+        num_processes=jax.process_count(),
+    )
+
+
+def tpu_resources() -> Dict[str, float]:
+    """Resource dict a node daemon advertises for its local chips.
+
+    Parity role: the reference's GPU autodetect (python/ray/_private/
+    resource_spec.py); here we advertise both the generic "TPU" count and a
+    typed "TPU-<gen>" resource so tasks can target a generation, plus an
+    accelerator_type label.
+    """
+    topo = detect_topology()
+    if topo.platform != "tpu":
+        return {}
+    n = float(topo.num_local_devices)
+    return {"TPU": n, f"TPU-{topo.generation}": n}

@@ -1,0 +1,30 @@
+"""ray_tpu.train — distributed training library (JAX-first).
+
+Parity surface: reference python/ray/train (BaseTrainer base_trainer.py:554,
+DataParallelTrainer data_parallel_trainer.py:56, BackendExecutor
+backend_executor.py:43). The torch/NCCL backend is replaced by pjit-compiled
+steps over a TPU mesh; `jax_step` is the single-controller compiled-step
+factory, the Trainer/WorkerGroup layer orchestrates multi-host SPMD.
+"""
+
+from ray_tpu.train.jax_step import (
+    TrainState,
+    make_lm_train_step,
+    make_resnet_train_step,
+)
+
+__all__ = ["TrainState", "make_lm_train_step", "make_resnet_train_step"]
+
+
+def __getattr__(name):
+    # Heavier trainer machinery is imported lazily so `import ray_tpu.train`
+    # stays light for pure-step users.
+    if name in ("ScalingConfig", "RunConfig", "CheckpointConfig",
+                "FailureConfig", "Checkpoint", "JaxTrainer",
+                "DataParallelTrainer", "report", "get_context"):
+        try:
+            from ray_tpu.train import trainer as _t
+        except ModuleNotFoundError as e:
+            raise AttributeError(name) from e
+        return getattr(_t, name)
+    raise AttributeError(name)
