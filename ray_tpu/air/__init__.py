@@ -1,0 +1,11 @@
+"""Shared ML plumbing (parity: python/ray/air — config.py:84, checkpoint.py,
+session facade)."""
+
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+
+__all__ = ["ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",
+           "Checkpoint", "Result", "session"]

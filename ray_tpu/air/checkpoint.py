@@ -1,0 +1,75 @@
+"""Checkpoint: a uniform dict/directory-polymorphic checkpoint object.
+
+Role parity: python/ray/air/checkpoint.py — one type that can be created
+from a dict or a directory, moved through the object store, and persisted
+to a path. Array-heavy dict checkpoints (jax pytrees) are stored with
+out-of-band buffers by the object plane, so passing a checkpoint between
+actors is zero-copy; directory checkpoints use orbax-compatible layouts
+(train.jax.JaxCheckpoint saves pytrees via orbax).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 path: Optional[str] = None):
+        if (data is None) == (path is None):
+            raise ValueError("pass exactly one of data= or path=")
+        self._data = data
+        self._path = path
+
+    # -- constructors ----------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=os.path.abspath(path))
+
+    # -- accessors -------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        blob_file = os.path.join(self._path, "_dict_checkpoint.pkl")
+        if os.path.exists(blob_file):
+            with open(blob_file, "rb") as f:
+                return pickle.load(f)
+        out: Dict[str, Any] = {}
+        for name in os.listdir(self._path):
+            with open(os.path.join(self._path, name), "rb") as f:
+                out[name] = f.read()
+        return out
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        os.makedirs(path, exist_ok=True)
+        if self._path is not None:
+            if os.path.abspath(path) != os.path.abspath(self._path):
+                shutil.copytree(self._path, path, dirs_exist_ok=True)
+            return path
+        with open(os.path.join(path, "_dict_checkpoint.pkl"), "wb") as f:
+            pickle.dump(self._data, f, protocol=5)
+        return path
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def __reduce__(self):
+        # dict checkpoints ship by value (out-of-band buffers keep arrays
+        # zero-copy); directory checkpoints ship by path.
+        return (Checkpoint, (self._data, self._path))
+
+    def __repr__(self):
+        if self._path:
+            return f"Checkpoint(path={self._path!r})"
+        return f"Checkpoint(dict with {len(self._data)} keys)"

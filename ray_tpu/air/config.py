@@ -1,0 +1,69 @@
+"""Run-level dataclass configs.
+
+Role parity: python/ray/air/config.py — ScalingConfig (:84), FailureConfig
+(:512), CheckpointConfig (:571), RunConfig (:699).
+
+TPU-first deltas in ScalingConfig: the accelerator knob is
+``tpus_per_worker`` (chips), ``topology`` names an ICI slice (e.g. "v4-8"),
+and ``mesh`` declares the parallelism axes (dp/fsdp/tp/sp/pp/ep) the pjit
+step will run over — the reference has no equivalent because torch DDP only
+does dp (SURVEY.md §2d).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1                      # one worker actor per host
+    use_tpu: bool = False
+    tpus_per_worker: float = 0.0              # chips reserved per worker
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    topology: str = ""                        # ICI slice, e.g. "v4-8"
+    placement_strategy: str = "PACK"
+    # Parallelism axes for the compiled step (dp=-1 -> infer remainder).
+    mesh: Dict[str, int] = field(default_factory=dict)
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = {"CPU": float(self.cpus_per_worker)}
+        if self.use_tpu or self.tpus_per_worker:
+            res["TPU"] = float(self.tpus_per_worker or 1.0)
+        res.update(self.resources_per_worker)
+        return res
+
+    def as_placement_group_factory(self):
+        """One bundle per worker (parity: air/config.py
+        as_placement_group_factory -> PlacementGroupFactory)."""
+        from ray_tpu.util.placement_group import placement_group
+        bundles = [self.worker_resources() for _ in range(self.num_workers)]
+        return lambda: placement_group(bundles,
+                                       strategy=self.placement_strategy)
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0          # trial restarts on failure; -1 = infinite
+    fail_fast: bool = False
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None        # local dir or URI for results
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Dict[str, Any]] = None     # e.g. {"training_iteration": 10}
+    verbose: int = 1

@@ -1,0 +1,71 @@
+"""In-process multi-node test cluster.
+
+Role parity: python/ray/cluster_utils.py:99 (Cluster, add_node:165,
+remove_node:238) — the reference's standard way to test distributed
+behavior (spillback, node death, transfer) without real machines: one
+conductor plus N node daemons in this process, workers as real
+subprocesses, each node with its own shm object store.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.cluster.conductor import Conductor
+from ray_tpu.cluster.node_daemon import NodeDaemon
+from ray_tpu.cluster.protocol import get_client
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None,
+                 health_timeout_s: float = 3.0):
+        self.conductor = Conductor(health_timeout_s=health_timeout_s)
+        self.address = self.conductor.address
+        self.nodes: List[NodeDaemon] = []
+        if initialize_head:
+            self.add_node(is_head=True, **(head_node_args or {}))
+
+    def add_node(self, num_cpus: float = 4.0, num_tpus: float = 0.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_bytes: int = 256 << 20,
+                 is_head: bool = False) -> NodeDaemon:
+        total = {"CPU": float(num_cpus)}
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        total.update(resources or {})
+        node = NodeDaemon(self.address, resources=total,
+                          object_store_bytes=object_store_bytes,
+                          is_head=is_head)
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: NodeDaemon, graceful: bool = False) -> None:
+        """Kill a node (workers included). graceful=True tells the conductor
+        first; False simulates a crash (health check finds out)."""
+        if graceful:
+            try:
+                get_client(self.address).call("drain_node",
+                                              node_id=node.node_id)
+            except Exception:
+                pass
+        node.stop()
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def wait_for_nodes(self, count: int, timeout: float = 10.0) -> None:
+        import time
+        deadline = time.monotonic() + timeout
+        cli = get_client(self.address)
+        while time.monotonic() < deadline:
+            alive = [n for n in cli.call("get_nodes") if n["alive"]]
+            if len(alive) >= count:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"cluster never reached {count} nodes")
+
+    def shutdown(self) -> None:
+        for node in list(self.nodes):
+            node.stop()
+        self.nodes.clear()
+        self.conductor.stop()

@@ -1,0 +1,663 @@
+"""Conductor: the cluster control plane (GCS equivalent).
+
+Role parity: src/ray/gcs/gcs_server/gcs_server.h:77 and its per-entity
+managers — node membership + health checks (gcs_health_check_manager.h),
+actor registration/restart FSM + actor scheduling (gcs_actor_manager.h:281,
+gcs_actor_scheduler.h:111), placement groups with 2PC prepare/commit across
+node daemons (gcs_placement_group_scheduler.h:265), cluster-wide KV
+(gcs_kv_manager.h), the object location directory (the reference resolves
+locations through object owners, ownership_based_object_directory.h; here
+the directory is centralized), and a task-event store powering the state
+API/timeline (gcs_task_manager.h:61).
+
+One conductor per cluster. All state is in-memory tables behind one lock,
+with condition-variable long-polls standing in for the reference's pub/sub
+channels (src/ray/pubsub/publisher.h:302).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.cluster.protocol import RpcServer, get_client
+
+# Actor FSM states (parity: gcs_actor_manager.h:249 state diagram).
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class ActorInfo:
+    def __init__(self, actor_id: bytes, spec: dict):
+        self.actor_id = actor_id
+        self.spec = spec              # class blob, args, opts (pickled pieces)
+        self.state = PENDING_CREATION
+        self.address: Optional[str] = None   # worker rpc address when ALIVE
+        self.node_id: Optional[bytes] = None
+        self.num_restarts = 0
+        self.death_reason = ""
+        self.incarnation = 0
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                 strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"        # PENDING | CREATED | REMOVED
+        self.bundle_nodes: List[Optional[bytes]] = [None] * len(bundles)
+
+
+class Conductor:
+    """In-memory control-plane tables + schedulers, served over RpcServer."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 health_timeout_s: float = 10.0):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._nodes: Dict[bytes, dict] = {}          # node_id -> info
+        self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        self._functions: Dict[str, bytes] = {}       # function_id -> blob
+        self._actors: Dict[bytes, ActorInfo] = {}
+        self._named_actors: Dict[Tuple[str, str], bytes] = {}
+        self._object_locations: Dict[bytes, Set[bytes]] = defaultdict(set)
+        self._object_spilled: Dict[bytes, str] = {}  # oid -> spill path/url
+        self._pgs: Dict[bytes, PlacementGroupInfo] = {}
+        self._task_events: List[dict] = []
+        self._job_counter = 0
+        self._health_timeout_s = health_timeout_s
+        self._stopped = False
+        self.server = RpcServer(self, host=host, port=port)
+        self.address = self.server.address
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="conductor-health")
+        self._health_thread.start()
+
+    # ------------------------------------------------------------------
+    # Node membership + resource view (parity: GcsNodeManager + RaySyncer)
+    # ------------------------------------------------------------------
+    def rpc_register_node(self, node_id: bytes, address: str,
+                          resources: Dict[str, float], store_socket: str,
+                          is_head: bool = False) -> dict:
+        with self._cv:
+            self._nodes[node_id] = {
+                "node_id": node_id,
+                "address": address,
+                "resources_total": dict(resources),
+                "resources_available": dict(resources),
+                "store_socket": store_socket,
+                "is_head": is_head,
+                "alive": True,
+                "last_heartbeat": time.monotonic(),
+            }
+            self._cv.notify_all()
+        return {"ok": True}
+
+    def rpc_heartbeat(self, node_id: bytes,
+                      resources_available: Dict[str, float]) -> dict:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or not info["alive"]:
+                return {"ok": False, "reregister": True}
+            info["last_heartbeat"] = time.monotonic()
+            info["resources_available"] = dict(resources_available)
+        return {"ok": True}
+
+    def rpc_drain_node(self, node_id: bytes) -> dict:
+        self._mark_node_dead(node_id, "drained")
+        return {"ok": True}
+
+    def rpc_get_nodes(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for v in self._nodes.values()]
+
+    def rpc_cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = defaultdict(float)
+        with self._lock:
+            for info in self._nodes.values():
+                if info["alive"]:
+                    for k, v in info["resources_total"].items():
+                        out[k] += v
+        return dict(out)
+
+    def rpc_available_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = defaultdict(float)
+        with self._lock:
+            for info in self._nodes.values():
+                if info["alive"]:
+                    for k, v in info["resources_available"].items():
+                        out[k] += v
+        return dict(out)
+
+    def _health_loop(self) -> None:
+        while not self._stopped:
+            time.sleep(self._health_timeout_s / 4)
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for nid, info in self._nodes.items():
+                    if info["alive"] and (
+                            now - info["last_heartbeat"] > self._health_timeout_s):
+                        dead.append(nid)
+            for nid in dead:
+                self._mark_node_dead(nid, "health check timed out")
+
+    def _mark_node_dead(self, node_id: bytes, reason: str) -> None:
+        to_restart: List[ActorInfo] = []
+        with self._cv:
+            info = self._nodes.get(node_id)
+            if info is None or not info["alive"]:
+                return
+            info["alive"] = False
+            # Drop its object locations; owners re-resolve and recover.
+            for oid, locs in list(self._object_locations.items()):
+                locs.discard(node_id)
+                if not locs and oid not in self._object_spilled:
+                    del self._object_locations[oid]
+            # Actors on this node die (and maybe restart).
+            for a in self._actors.values():
+                if a.node_id == node_id and a.state in (ALIVE, PENDING_CREATION,
+                                                        RESTARTING):
+                    to_restart.append(a)
+            # Placement groups lose bundles on this node -> back to PENDING.
+            for pg in self._pgs.values():
+                if pg.state == "CREATED" and node_id in pg.bundle_nodes:
+                    pg.state = "PENDING"
+                    pg.bundle_nodes = [
+                        None if n == node_id else n for n in pg.bundle_nodes]
+            self._cv.notify_all()
+        for a in to_restart:
+            self._on_actor_death(a.actor_id, f"node died: {reason}")
+        # Re-place any PGs knocked back to PENDING.
+        with self._lock:
+            pending = [pg for pg in self._pgs.values() if pg.state == "PENDING"]
+        for pg in pending:
+            self._try_place_pg(pg)
+
+    # ------------------------------------------------------------------
+    # KV + function table (parity: gcs_kv_manager.h, gcs_function_manager.h)
+    # ------------------------------------------------------------------
+    def rpc_kv_put(self, ns: str, key: bytes, value: bytes,
+                   overwrite: bool = True) -> bool:
+        with self._cv:
+            if not overwrite and (ns, key) in self._kv:
+                return False
+            self._kv[(ns, key)] = value
+            self._cv.notify_all()
+        return True
+
+    def rpc_kv_get(self, ns: str, key: bytes,
+                   wait_timeout: float = 0.0) -> Optional[bytes]:
+        deadline = time.monotonic() + wait_timeout
+        with self._cv:
+            while True:
+                v = self._kv.get((ns, key))
+                if v is not None or wait_timeout <= 0:
+                    return v
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+
+    def rpc_kv_del(self, ns: str, key: bytes) -> bool:
+        with self._lock:
+            return self._kv.pop((ns, key), None) is not None
+
+    def rpc_kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
+        with self._lock:
+            return [k for (n, k) in self._kv if n == ns and k.startswith(prefix)]
+
+    def rpc_put_function(self, function_id: str, blob: bytes) -> None:
+        with self._lock:
+            self._functions[function_id] = blob
+
+    def rpc_get_function(self, function_id: str) -> Optional[bytes]:
+        with self._lock:
+            return self._functions.get(function_id)
+
+    # ------------------------------------------------------------------
+    # Object directory (centralizes ownership_based_object_directory.h)
+    # ------------------------------------------------------------------
+    def rpc_add_object_location(self, oid: bytes, node_id: bytes) -> None:
+        with self._cv:
+            self._object_locations[oid].add(node_id)
+            self._cv.notify_all()
+
+    def rpc_remove_object_location(self, oid: bytes, node_id: bytes) -> None:
+        with self._lock:
+            locs = self._object_locations.get(oid)
+            if locs:
+                locs.discard(node_id)
+
+    def rpc_add_spilled(self, oid: bytes, url: str) -> None:
+        with self._cv:
+            self._object_spilled[oid] = url
+            self._cv.notify_all()
+
+    def rpc_locate_object(self, oid: bytes, timeout: float = 0.0) -> dict:
+        """Resolve an object to live node addresses (+ spill url if any)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                locs = [self._nodes[n] for n in self._object_locations.get(oid, ())
+                        if n in self._nodes and self._nodes[n]["alive"]]
+                spilled = self._object_spilled.get(oid)
+                if locs or spilled or timeout <= 0:
+                    return {
+                        "nodes": [{"node_id": n["node_id"],
+                                   "address": n["address"]} for n in locs],
+                        "spilled": spilled,
+                    }
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"nodes": [], "spilled": None}
+                self._cv.wait(min(remaining, 1.0))
+
+    def rpc_free_object(self, oid: bytes) -> None:
+        with self._lock:
+            nodes = [self._nodes[n]["address"]
+                     for n in self._object_locations.pop(oid, ())
+                     if n in self._nodes and self._nodes[n]["alive"]]
+            self._object_spilled.pop(oid, None)
+        for addr in nodes:
+            try:
+                get_client(addr).call("delete_object", oid=oid)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # Actor manager + scheduler (parity: gcs_actor_manager.h:281,
+    # gcs_actor_scheduler.h:111 ScheduleByRaylet mode)
+    # ------------------------------------------------------------------
+    def rpc_register_actor(self, actor_id: bytes, spec: dict) -> dict:
+        name = spec["opts"].get("name") or ""
+        ns = spec["opts"].get("namespace") or "default"
+        with self._cv:
+            if name:
+                existing = self._named_actors.get((ns, name))
+                if existing is not None and \
+                        self._actors[existing].state != DEAD:
+                    if spec["opts"].get("get_if_exists"):
+                        return {"existing": existing}
+                    raise ValueError(
+                        f"Actor name {name!r} already taken in namespace {ns!r}")
+                self._named_actors[(ns, name)] = actor_id
+            self._actors[actor_id] = ActorInfo(actor_id, spec)
+            self._cv.notify_all()
+        self._schedule_actor(actor_id)
+        return {"existing": None}
+
+    def _pick_node_for(self, resources: Dict[str, float],
+                       strategy: Any = None) -> Optional[dict]:
+        """Feasibility-checked bin-pack over the live resource view.
+
+        Parity: hybrid_scheduling_policy.h:50 — prefer the most-available
+        feasible node (scored by remaining capacity) so load spreads once
+        nodes fill; placement-group strategies pin to the bundle's node.
+        """
+        with self._lock:
+            if isinstance(strategy, dict) and strategy.get("type") == "pg":
+                pg = self._pgs.get(strategy["pg_id"])
+                if pg is None or pg.state != "CREATED":
+                    return None
+                idx = strategy.get("bundle_index", 0)
+                if idx == -1:
+                    idx = 0
+                nid = pg.bundle_nodes[idx]
+                info = self._nodes.get(nid)
+                return dict(info) if info and info["alive"] else None
+            if isinstance(strategy, dict) and strategy.get("type") == "node":
+                info = self._nodes.get(strategy["node_id"])
+                if info and info["alive"]:
+                    return dict(info)
+                return None if not strategy.get("soft") else self._best_fit(
+                    resources)
+            return self._best_fit(resources)
+
+    def _best_fit(self, resources: Dict[str, float]) -> Optional[dict]:
+        best, best_score = None, -1.0
+        for info in self._nodes.values():
+            if not info["alive"]:
+                continue
+            avail = info["resources_available"]
+            total = info["resources_total"]
+            if any(avail.get(k, 0.0) + 1e-9 < v for k, v in resources.items()
+                   if v > 0):
+                continue
+            # Score: fraction of capacity left after placing (pack towards
+            # busy-but-feasible nodes is the reference PACK flavor; we spread
+            # by preferring the emptiest feasible node for throughput).
+            score = sum(avail.get(k, 0.0) / max(total.get(k, 1.0), 1e-9)
+                        for k in ("CPU", "TPU"))
+            if score > best_score:
+                best, best_score = info, score
+        return dict(best) if best else None
+
+    def _schedule_actor(self, actor_id: bytes) -> None:
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a is None or a.state == DEAD:
+                return
+            spec = a.spec
+        node = self._pick_node_for(spec["opts"].get("resources_req", {"CPU": 1.0}),
+                                   spec["opts"].get("scheduling_strategy"))
+        if node is None:
+            # No feasible node now: retry when membership/resources change.
+            threading.Timer(0.2, self._schedule_actor, args=(actor_id,)).start()
+            return
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a is None or a.state == DEAD:
+                return
+            a.node_id = node["node_id"]
+            incarnation = a.incarnation
+        try:
+            get_client(node["address"]).call(
+                "start_actor", actor_id=actor_id, spec=spec,
+                incarnation=incarnation)
+        except Exception as e:  # node unreachable -> mark dead, reschedule
+            self._mark_node_dead(node["node_id"], f"unreachable: {e}")
+
+    def rpc_actor_started(self, actor_id: bytes, address: str,
+                          node_id: bytes, incarnation: int) -> None:
+        with self._cv:
+            a = self._actors.get(actor_id)
+            if a is None or a.incarnation != incarnation:
+                return
+            a.state = ALIVE
+            a.address = address
+            a.node_id = node_id
+            self._cv.notify_all()
+
+    def rpc_actor_creation_failed(self, actor_id: bytes, incarnation: int,
+                                  error_blob: bytes) -> None:
+        with self._cv:
+            a = self._actors.get(actor_id)
+            if a is None or a.incarnation != incarnation:
+                return
+            a.state = DEAD
+            a.death_reason = "creation failed"
+            a.spec["creation_error"] = error_blob
+            self._drop_name(a)
+            self._cv.notify_all()
+
+    def rpc_report_actor_death(self, actor_id: bytes, reason: str) -> None:
+        self._on_actor_death(actor_id, reason)
+
+    def _on_actor_death(self, actor_id: bytes, reason: str) -> None:
+        """Restart FSM (parity: gcs_actor_manager.h ALIVE->RESTARTING->...)."""
+        with self._cv:
+            a = self._actors.get(actor_id)
+            if a is None or a.state == DEAD:
+                return
+            max_restarts = a.spec["opts"].get("max_restarts", 0)
+            if max_restarts == -1 or a.num_restarts < max_restarts:
+                a.num_restarts += 1
+                a.incarnation += 1
+                a.state = RESTARTING
+                a.address = None
+                self._cv.notify_all()
+                restart = True
+            else:
+                a.state = DEAD
+                a.death_reason = reason
+                a.address = None
+                self._drop_name(a)
+                self._cv.notify_all()
+                restart = False
+        if restart:
+            self._schedule_actor(actor_id)
+
+    def _drop_name(self, a: ActorInfo) -> None:
+        name = a.spec["opts"].get("name") or ""
+        ns = a.spec["opts"].get("namespace") or "default"
+        if name and self._named_actors.get((ns, name)) == a.actor_id:
+            del self._named_actors[(ns, name)]
+
+    def rpc_kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
+        with self._cv:
+            a = self._actors.get(actor_id)
+            if a is None:
+                return
+            if no_restart:
+                a.spec["opts"]["max_restarts"] = 0
+            addr = a.address
+        if addr:
+            try:
+                get_client(addr).call("kill_actor", actor_id=actor_id)
+            except Exception:
+                pass
+        self._on_actor_death(actor_id, "killed via kill()")
+
+    def rpc_get_actor_info(self, actor_id: bytes,
+                           wait_alive_timeout: float = 0.0) -> dict:
+        """Resolve an actor's state/address; optionally long-poll until it
+        leaves PENDING/RESTARTING (parity: actor address pubsub)."""
+        deadline = time.monotonic() + wait_alive_timeout
+        with self._cv:
+            while True:
+                a = self._actors.get(actor_id)
+                if a is None:
+                    return {"state": "UNKNOWN"}
+                if a.state in (ALIVE, DEAD) or wait_alive_timeout <= 0:
+                    return {"state": a.state, "address": a.address,
+                            "node_id": a.node_id,
+                            "incarnation": a.incarnation,
+                            "death_reason": a.death_reason,
+                            "creation_error": a.spec.get("creation_error"),
+                            "class_name": a.spec.get("class_name", "")}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"state": a.state, "address": a.address,
+                            "node_id": a.node_id,
+                            "incarnation": a.incarnation,
+                            "death_reason": a.death_reason,
+                            "creation_error": a.spec.get("creation_error"),
+                            "class_name": a.spec.get("class_name", "")}
+                self._cv.wait(min(remaining, 1.0))
+
+    def rpc_get_named_actor(self, name: str, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._named_actors.get((namespace or "default", name))
+
+    def rpc_list_actors(self) -> List[dict]:
+        with self._lock:
+            return [{"actor_id": a.actor_id.hex(), "state": a.state,
+                     "class_name": a.spec.get("class_name", ""),
+                     "name": a.spec["opts"].get("name", ""),
+                     "node_id": a.node_id.hex() if a.node_id else None,
+                     "num_restarts": a.num_restarts,
+                     "pid": None}
+                    for a in self._actors.values()]
+
+    # ------------------------------------------------------------------
+    # Placement groups (parity: gcs_placement_group_manager.h:223 +
+    # 2PC prepare/commit of gcs_placement_group_scheduler.h:265)
+    # ------------------------------------------------------------------
+    def rpc_create_placement_group(self, pg_id: bytes,
+                                   bundles: List[Dict[str, float]],
+                                   strategy: str, name: str = "") -> None:
+        pg = PlacementGroupInfo(pg_id, bundles, strategy, name)
+        with self._lock:
+            self._pgs[pg_id] = pg
+        self._try_place_pg(pg)
+
+    def _try_place_pg(self, pg: PlacementGroupInfo) -> None:
+        """Pick nodes per strategy, then 2PC: prepare on every node; commit
+        all on success, return-on-any-failure (retry later)."""
+        with self._lock:
+            if pg.state != "PENDING":
+                return
+            live = [dict(v) for v in self._nodes.values() if v["alive"]]
+        plan = self._plan_bundles(pg, live)
+        if plan is None:
+            threading.Timer(0.5, self._try_place_pg, args=(pg,)).start()
+            return
+        prepared: List[Tuple[bytes, str, int]] = []
+        ok = True
+        for idx, node in enumerate(plan):
+            try:
+                granted = get_client(node["address"]).call(
+                    "prepare_bundle", pg_id=pg.pg_id, bundle_index=idx,
+                    resources=pg.bundles[idx])
+            except Exception:
+                granted = False
+            if not granted:
+                ok = False
+                break
+            prepared.append((node["node_id"], node["address"], idx))
+        if not ok:
+            for _, addr, idx in prepared:
+                try:
+                    get_client(addr).call("return_bundle", pg_id=pg.pg_id,
+                                          bundle_index=idx)
+                except Exception:
+                    pass
+            threading.Timer(0.5, self._try_place_pg, args=(pg,)).start()
+            return
+        for _, addr, idx in prepared:
+            try:
+                get_client(addr).call("commit_bundle", pg_id=pg.pg_id,
+                                      bundle_index=idx)
+            except Exception:
+                pass
+        with self._cv:
+            pg.bundle_nodes = [n["node_id"] for n in plan]
+            pg.state = "CREATED"
+            self._cv.notify_all()
+
+    def _plan_bundles(self, pg: PlacementGroupInfo,
+                      live: List[dict]) -> Optional[List[dict]]:
+        """STRICT_PACK: all on one node. PACK: prefer few nodes. SPREAD:
+        round-robin distinct nodes. STRICT_SPREAD: distinct node per bundle.
+        Bundle feasibility is checked against available resources."""
+        def fits(avail, res):
+            return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in res.items())
+
+        avail = {n["node_id"]: dict(n["resources_available"]) for n in live}
+        by_id = {n["node_id"]: n for n in live}
+
+        def take(nid, res):
+            for k, v in res.items():
+                avail[nid][k] = avail[nid].get(k, 0.0) - v
+
+        plan: List[dict] = []
+        if pg.strategy in ("STRICT_PACK", "PACK"):
+            order = sorted(live, key=lambda n: -sum(
+                n["resources_available"].get(k, 0.0) for k in ("CPU", "TPU")))
+            if pg.strategy == "STRICT_PACK":
+                for n in order:
+                    a = dict(avail[n["node_id"]])
+                    if all(fits_and_take(a, b) for b in pg.bundles):
+                        return [n] * len(pg.bundles)
+                return None
+            for b in pg.bundles:
+                placed = False
+                for n in plan + order:  # prefer already-used nodes (PACK)
+                    nid = n["node_id"]
+                    if fits(avail[nid], b):
+                        take(nid, b)
+                        plan.append(by_id[nid])
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return plan
+        # SPREAD / STRICT_SPREAD
+        used: Set[bytes] = set()
+        for b in pg.bundles:
+            candidates = sorted(
+                live, key=lambda n: (n["node_id"] in used, -sum(
+                    avail[n["node_id"]].get(k, 0.0) for k in ("CPU", "TPU"))))
+            placed = False
+            for n in candidates:
+                nid = n["node_id"]
+                if pg.strategy == "STRICT_SPREAD" and nid in used:
+                    continue
+                if fits(avail[nid], b):
+                    take(nid, b)
+                    used.add(nid)
+                    plan.append(n)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return plan
+
+    def rpc_pg_ready(self, pg_id: bytes, timeout: float = 0.0) -> dict:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                pg = self._pgs.get(pg_id)
+                if pg is None:
+                    return {"state": "UNKNOWN"}
+                if pg.state == "CREATED" or timeout <= 0:
+                    return {"state": pg.state,
+                            "bundle_nodes": list(pg.bundle_nodes)}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"state": pg.state,
+                            "bundle_nodes": list(pg.bundle_nodes)}
+                self._cv.wait(min(remaining, 1.0))
+
+    def rpc_remove_placement_group(self, pg_id: bytes) -> None:
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is None:
+                return
+            pg.state = "REMOVED"
+            targets = [(self._nodes[n]["address"], i)
+                       for i, n in enumerate(pg.bundle_nodes)
+                       if n in self._nodes and self._nodes[n]["alive"]]
+        for addr, idx in targets:
+            try:
+                get_client(addr).call("return_bundle", pg_id=pg_id,
+                                      bundle_index=idx)
+            except Exception:
+                pass
+
+    def rpc_list_placement_groups(self) -> List[dict]:
+        with self._lock:
+            return [{"pg_id": pg.pg_id.hex(), "state": pg.state,
+                     "strategy": pg.strategy, "name": pg.name,
+                     "bundles": pg.bundles} for pg in self._pgs.values()]
+
+    # ------------------------------------------------------------------
+    # Task events / jobs (parity: gcs_task_manager.h:61, GcsJobManager)
+    # ------------------------------------------------------------------
+    def rpc_push_task_events(self, events: List[dict]) -> None:
+        with self._lock:
+            self._task_events.extend(events)
+            if len(self._task_events) > 100_000:
+                del self._task_events[:len(self._task_events) - 100_000]
+
+    def rpc_get_task_events(self) -> List[dict]:
+        with self._lock:
+            return list(self._task_events)
+
+    def rpc_next_job_id(self) -> int:
+        with self._lock:
+            self._job_counter += 1
+            return self._job_counter
+
+    def rpc_ping(self) -> str:
+        return "pong"
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.server.stop()
+
+
+def fits_and_take(avail: Dict[str, float], res: Dict[str, float]) -> bool:
+    if any(avail.get(k, 0.0) + 1e-9 < v for k, v in res.items()):
+        return False
+    for k, v in res.items():
+        avail[k] = avail.get(k, 0.0) - v
+    return True

@@ -129,11 +129,14 @@ class ShmClient:
 
     def get(self, oid: bytes, timeout: Optional[float] = None
             ) -> Optional[memoryview]:
-        """Blocking get -> zero-copy readonly view, or None on timeout."""
+        """Blocking get -> zero-copy readonly view; None when the object is
+        not available (timeout, not created yet, or writer has not sealed)."""
         timeout_ms = -1 if timeout is None else int(timeout * 1000)
         resp = self._call(struct.pack("<B16sq", OP_GET, oid, timeout_ms))
         st = resp[0]
-        if st == ST_TIMEOUT:
+        if st in (ST_TIMEOUT, ST_NOT_FOUND, ST_NOT_SEALED):
+            # NOT_SEALED: a writer is mid-create; readers retry like not-yet-
+            # created (sealing is the visibility barrier, plasma semantics).
             return None
         if st != ST_OK:
             raise ObjectStoreError(f"get failed: status {st}")

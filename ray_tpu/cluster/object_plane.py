@@ -1,0 +1,132 @@
+"""Object plane: local shm store + remote pull + location directory.
+
+Role parity: the core worker's plasma provider + PullManager
+(core_worker.cc:1307 Get -> plasma -> raylet pull, pull_manager.h:52).
+Shared by the driver runtime and by worker processes: values are serialized
+with out-of-band buffers (core/serialization.py), stored in the node's
+shmstored, registered in the conductor's object directory, and pulled
+node-to-node in chunks when non-local.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.cluster import object_client
+from ray_tpu.cluster.node_daemon import CHUNK_SIZE
+from ray_tpu.cluster.protocol import get_client
+from ray_tpu.core import serialization
+from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectPlane:
+    def __init__(self, store: object_client.ShmClient, node_id: bytes,
+                 conductor_address: str):
+        self.store = store
+        self.node_id = node_id
+        self.conductor = get_client(conductor_address)
+        self._pull_locks: Dict[bytes, threading.Lock] = {}
+        self._pull_guard = threading.Lock()
+
+    # -- write ----------------------------------------------------------
+    def put_value(self, oid: ObjectID, value: Any) -> int:
+        blob, _refs = serialization.serialize(value)
+        return self.put_blob(oid, blob)
+
+    def put_blob(self, oid: ObjectID, blob: bytes) -> int:
+        key = self._key(oid)
+        try:
+            buf = self.store.create(key, len(blob))
+            if len(blob):
+                buf[:] = blob
+            self.store.seal(key)
+        except object_client.ObjectStoreError as e:
+            if "already exists" not in str(e):
+                raise
+        self.conductor.call("add_object_location", oid=key,
+                            node_id=self.node_id)
+        return len(blob)
+
+    # -- read -----------------------------------------------------------
+    def _key(self, oid: ObjectID) -> bytes:
+        # shmstored keys are 16 bytes; ObjectIDs are 20 (task id + index).
+        import hashlib
+        return hashlib.blake2b(oid.binary(), digest_size=16).digest()
+
+    def contains(self, oid: ObjectID) -> bool:
+        return self.store.contains(self._key(oid))
+
+    def get_value(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        view = self.get_view(oid, timeout=timeout)
+        value = serialization.deserialize(view)
+        # NOTE: buffer-backed values (numpy arrays) stay zero-copy views over
+        # the shm mapping; the mapping outlives release() (mmap semantics).
+        self.store.release(self._key(oid))
+        return value
+
+    def get_view(self, oid: ObjectID,
+                 timeout: Optional[float] = None) -> memoryview:
+        key = self._key(oid)
+        # Fast path: local.
+        view = self.store.get(key, timeout=0.0)
+        if view is not None:
+            return view
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = 2.0 if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(
+                    f"timed out waiting for object {oid.hex()}")
+            loc = self.conductor.call("locate_object", oid=key,
+                                      timeout=min(remaining, 2.0))
+            view = self.store.get(key, timeout=0.0)
+            if view is not None:
+                return view
+            for node in loc["nodes"]:
+                if node["node_id"] == self.node_id:
+                    continue
+                if self._pull(key, node["address"]):
+                    view = self.store.get(key, timeout=0.0)
+                    if view is not None:
+                        return view
+            # No location known yet (still being computed) -> loop.
+
+    def _pull(self, key: bytes, remote_addr: str) -> bool:
+        """Chunked pull of one object from a remote daemon into local shm.
+
+        Single-flight per object: concurrent getters wait on the same pull.
+        """
+        with self._pull_guard:
+            lock = self._pull_locks.setdefault(key, threading.Lock())
+        with lock:
+            if self.store.contains(key):
+                return True
+            cli = get_client(remote_addr)
+            try:
+                info = cli.call("object_info", oid=key)
+                if not info["found"]:
+                    return False
+                size = info["size"]
+                buf = self.store.create(key, size)
+                off = 0
+                while off < size:
+                    n = min(CHUNK_SIZE, size - off)
+                    chunk = cli.call("fetch_chunk", oid=key, offset=off, size=n)
+                    buf[off:off + n] = chunk
+                    off += n
+                self.store.seal(key)
+            except object_client.ObjectStoreError as e:
+                if "already exists" in str(e):
+                    return True
+                raise
+            except Exception:
+                return False
+            self.conductor.call("add_object_location", oid=key,
+                                node_id=self.node_id)
+            return True
+
+    def free(self, oid: ObjectID) -> None:
+        self.conductor.call("free_object", oid=self._key(oid))

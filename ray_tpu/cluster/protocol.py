@@ -1,0 +1,189 @@
+"""Control-plane RPC: length-prefixed pickle frames over TCP.
+
+Role parity: src/ray/rpc/grpc_server.h / grpc_client.h — the reference wraps
+gRPC; here the control plane is a small threaded RPC layer (the data plane
+never goes through it: large objects move via the shm store and node-to-node
+chunk streaming in node_daemon.py, and dense math moves over ICI via XLA
+collectives).
+
+Wire format: [4B little-endian length][pickle((method, kwargs))] request,
+[4B length][pickle((ok, payload))] response. One in-flight request per
+connection; clients pool connections per target address.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionLost("connection closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, length)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        service = self.server.service  # type: ignore[attr-defined]
+        while True:
+            try:
+                req = _recv_frame(sock)
+            except (ConnectionLost, OSError):
+                return
+            try:
+                method, kwargs = pickle.loads(req)
+                fn = getattr(service, "rpc_" + method, None)
+                if fn is None:
+                    resp = (False, RpcError(f"no such method: {method}"))
+                else:
+                    resp = (True, fn(**kwargs))
+            except SystemExit:
+                raise
+            except BaseException as e:  # noqa: BLE001 - shipped to caller
+                try:
+                    resp = (False, e)
+                except Exception:
+                    resp = (False, RpcError(repr(e)))
+            try:
+                _send_frame(sock, pickle.dumps(resp, protocol=5))
+            except (OSError, pickle.PicklingError):
+                try:
+                    _send_frame(sock, pickle.dumps(
+                        (False, RpcError("unpicklable response")), protocol=5))
+                except OSError:
+                    return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class RpcServer:
+    """Serves ``rpc_*`` methods of a service object on host:port.
+
+    Handlers run on a thread per connection; blocking inside a handler (e.g.
+    a long-poll wait on a condition variable) only stalls that client.
+    """
+
+    def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0):
+        self._srv = _Server((host, port), _Handler)
+        self._srv.service = service  # type: ignore[attr-defined]
+        self.host, self.port = self._srv.server_address[:2]
+        self.address = f"{self.host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name=f"rpc-{type(service).__name__}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        try:
+            self._srv.shutdown()
+            self._srv.server_close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Pooled client: one socket per concurrent caller to one address."""
+
+    def __init__(self, address: str, timeout: Optional[float] = None):
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self._target = (host, int(port))
+        self._timeout = timeout
+        self._free: list = []
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._target, timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def call(self, method: str, _timeout: Optional[float] = None, **kwargs) -> Any:
+        with self._lock:
+            sock = self._free.pop() if self._free else None
+        if sock is None:
+            sock = self._connect()
+        try:
+            if _timeout is not None:
+                sock.settimeout(_timeout)
+            _send_frame(sock, pickle.dumps((method, kwargs), protocol=5))
+            ok, payload = pickle.loads(_recv_frame(sock))
+            if _timeout is not None:
+                sock.settimeout(self._timeout)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        with self._lock:
+            if self._closed:
+                sock.close()
+            else:
+                self._free.append(sock)
+        if not ok:
+            raise payload if isinstance(payload, BaseException) else RpcError(
+                str(payload))
+        return payload
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            socks, self._free = self._free, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+_client_pool: Dict[Tuple[str, Optional[float]], RpcClient] = {}
+_client_pool_lock = threading.Lock()
+
+
+def get_client(address: str, timeout: Optional[float] = None) -> RpcClient:
+    """Process-wide client cache (parity: rpc/worker/core_worker_client_pool.h)."""
+    key = (address, timeout)
+    with _client_pool_lock:
+        cli = _client_pool.get(key)
+        if cli is None:
+            cli = RpcClient(address, timeout=timeout)
+            _client_pool[key] = cli
+        return cli
+
+
+def drop_client(address: str) -> None:
+    with _client_pool_lock:
+        for key in [k for k in _client_pool if k[0] == address]:
+            _client_pool.pop(key).close()

@@ -1,0 +1,341 @@
+"""Worker process: executes tasks and hosts actors.
+
+Role parity: the core worker's execution half — HandlePushTask
+(core_worker.cc:2925) -> ExecuteTask (:2525) -> the Python trampoline
+(_raylet.pyx:718 execute_task), plus the receiver-side scheduling queues
+(transport/actor_scheduling_queue.h: per-caller sequence-number ordering,
+out-of-order mode for max_concurrency>1, asyncio actors standing in for the
+boost::fiber loop of fiber.h) and the per-worker main loop
+(default_worker.py:258 / core_worker_process.cc:63 RunTaskExecutionLoop).
+
+One worker process == one lease at a time (normal tasks execute serially)
+or one dedicated actor. Workers are also full API clients: user code running
+here can submit nested tasks/actors through the same ClusterRuntime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import inspect
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.cluster import object_client
+from ray_tpu.cluster.object_plane import ObjectPlane
+from ray_tpu.cluster.protocol import RpcServer, get_client
+from ray_tpu.core import serialization
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.ids import ObjectID, TaskID, WorkerID
+
+
+class TaskEventLog:
+    """Buffered task-event shipping (parity: task_event_buffer.h:188)."""
+
+    def __init__(self, conductor_address: str, node_id: bytes, pid: int):
+        self._events = []
+        self._lock = threading.Lock()
+        self._cli = get_client(conductor_address)
+        self._node_hex = node_id.hex()
+        self._pid = pid
+        self._flusher = threading.Thread(target=self._loop, daemon=True)
+        self._flusher.start()
+
+    def record(self, task_id: bytes, name: str, kind: str,
+               start: float, end: float, error: str = "") -> None:
+        with self._lock:
+            self._events.append({
+                "task_id": task_id.hex(), "name": name, "kind": kind,
+                "start": start, "end": end, "node_id": self._node_hex,
+                "pid": self._pid, "error": error,
+            })
+
+    def _loop(self) -> None:
+        while True:
+            time.sleep(1.0)
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            events, self._events = self._events, []
+        if events:
+            try:
+                self._cli.call("push_task_events", events=events)
+            except Exception:
+                pass
+
+
+class WorkerService:
+    """The worker's RPC surface (tasks pushed directly by submitters)."""
+
+    def __init__(self, conductor_address: str, daemon_address: str,
+                 store_socket: str, store_prefix: str, node_id: bytes):
+        self.worker_id = WorkerID.from_random()
+        self.conductor_address = conductor_address
+        self.daemon_address = daemon_address
+        self.node_id = node_id
+        self.store = object_client.ShmClient(store_socket, store_prefix)
+        self.plane = ObjectPlane(self.store, node_id, conductor_address)
+        self.events = TaskEventLog(conductor_address, node_id, os.getpid())
+        self._fn_cache: Dict[str, Any] = {}
+        self._exec_lock = threading.Lock()   # serial normal-task execution
+        self._cancelled: set = set()
+        # --- actor state (one dedicated actor per worker) ---
+        self.actor_id: Optional[bytes] = None
+        self.actor_instance: Any = None
+        self.actor_class_name = ""
+        self.actor_is_async = False
+        self.actor_max_concurrency = 1
+        self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
+        self.actor_pool = None
+        # per-caller ordering (parity: actor_scheduling_queue.h)
+        self._seq_lock = threading.Lock()
+        self._seq_cv = threading.Condition(self._seq_lock)
+        self._next_seq: Dict[bytes, int] = {}
+        self._shutdown = threading.Event()
+
+    # ------------------------------------------------------------------
+    def _load_fn(self, function_id: str, blob: Optional[bytes]):
+        fn = self._fn_cache.get(function_id)
+        if fn is None:
+            if blob is None:
+                blob = get_client(self.conductor_address).call(
+                    "get_function", function_id=function_id)
+                if blob is None:
+                    raise RuntimeError(
+                        f"function {function_id} not found in function table")
+            fn = serialization.loads(blob)
+            self._fn_cache[function_id] = fn
+        return fn
+
+    def _resolve(self, args_blob: bytes):
+        from ray_tpu.core.refs import ObjectRef
+        args, kwargs = serialization.loads(args_blob)
+
+        def rv(v):
+            return self.plane.get_value(v.id) if isinstance(v, ObjectRef) else v
+
+        return [rv(a) for a in args], {k: rv(v) for k, v in kwargs.items()}
+
+    def _store_returns(self, task_id: bytes, num_returns: int, result: Any):
+        tid = TaskID(task_id)
+        if num_returns == 1:
+            self.plane.put_value(tid.object_id_for_return(0), result)
+            return
+        vals = list(result)
+        if len(vals) != num_returns:
+            err = TaskError.from_exception(ValueError(
+                f"Task declared num_returns={num_returns} but returned "
+                f"{len(vals)} values"))
+            for i in range(num_returns):
+                self.plane.put_value(tid.object_id_for_return(i), err)
+            return
+        for i, v in enumerate(vals):
+            self.plane.put_value(tid.object_id_for_return(i), v)
+
+    def _fail_returns(self, task_id: bytes, num_returns: int, exc, desc: str):
+        err = exc if isinstance(exc, TaskError) else TaskError.from_exception(
+            exc, desc)
+        tid = TaskID(task_id)
+        for i in range(num_returns):
+            self.plane.put_value(tid.object_id_for_return(i), err)
+
+    # ------------------------------------------------------------------
+    # normal tasks
+    # ------------------------------------------------------------------
+    def rpc_push_task(self, task_id: bytes, function_id: str,
+                      function_blob: Optional[bytes], args_blob: bytes,
+                      num_returns: int, name: str = "") -> dict:
+        """Execute one task; ack after its returns are stored."""
+        start = time.time()
+        with self._exec_lock:
+            if task_id in self._cancelled:
+                self._cancelled.discard(task_id)
+                from ray_tpu.core.exceptions import TaskCancelledError
+                self._fail_returns(task_id, num_returns,
+                                   TaskCancelledError("task cancelled"), name)
+                return {"ok": True, "cancelled": True}
+            error = ""
+            try:
+                fn = self._load_fn(function_id, function_blob)
+                args, kwargs = self._resolve(args_blob)
+                result = fn(*args, **kwargs)
+                self._store_returns(task_id, num_returns, result)
+            except BaseException as e:  # noqa: BLE001 - delivered via refs
+                error = repr(e)
+                self._fail_returns(task_id, num_returns, e, name)
+        self.events.record(task_id, name, "task", start, time.time(), error)
+        return {"ok": True}
+
+    def rpc_cancel_task(self, task_id: bytes) -> None:
+        self._cancelled.add(task_id)
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def rpc_create_actor(self, actor_id: bytes, spec: dict,
+                         incarnation: int) -> dict:
+        start = time.time()
+        try:
+            cls = self._load_fn(spec["function_id"], spec.get("class_blob"))
+            args, kwargs = self._resolve(spec["args_blob"])
+            instance = cls(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            import pickle
+            try:
+                blob = pickle.dumps(TaskError.from_exception(
+                    e, spec.get("class_name", "") + ".__init__"))
+            except Exception:
+                blob = pickle.dumps(TaskError(repr(e), ""))
+            get_client(self.conductor_address).call(
+                "actor_creation_failed", actor_id=actor_id,
+                incarnation=incarnation, error_blob=blob)
+            return {"ok": False}
+        self.actor_id = actor_id
+        self.actor_instance = instance
+        self.actor_class_name = spec.get("class_name", "")
+        self.actor_is_async = spec.get("is_async", False)
+        self.actor_max_concurrency = spec["opts"].get("max_concurrency", 1)
+        if self.actor_is_async:
+            self.actor_loop = asyncio.new_event_loop()
+            threading.Thread(target=self.actor_loop.run_forever,
+                             daemon=True, name="actor-loop").start()
+        elif self.actor_max_concurrency > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            self.actor_pool = ThreadPoolExecutor(
+                max_workers=self.actor_max_concurrency,
+                thread_name_prefix="actor")
+        get_client(self.conductor_address).call(
+            "actor_started", actor_id=actor_id, address=self.address,
+            node_id=self.node_id, incarnation=incarnation)
+        self.events.record(actor_id + b"\x00" * 4,
+                           self.actor_class_name + ".__init__",
+                           "actor_creation", start, time.time())
+        return {"ok": True}
+
+    def _wait_turn(self, caller_id: bytes, seqno: int) -> None:
+        with self._seq_cv:
+            while self._next_seq.get(caller_id, 0) != seqno:
+                self._seq_cv.wait(1.0)
+
+    def _done_turn(self, caller_id: bytes, seqno: int) -> None:
+        with self._seq_cv:
+            nxt = self._next_seq.get(caller_id, 0)
+            if seqno >= nxt:
+                self._next_seq[caller_id] = seqno + 1
+            self._seq_cv.notify_all()
+
+    def rpc_push_actor_task(self, task_id: bytes, caller_id: bytes,
+                            seqno: int, method_name: str, args_blob: bytes,
+                            num_returns: int) -> dict:
+        """Ordered actor call (per-caller seqno; see class docstring)."""
+        if self.actor_instance is None:
+            raise RuntimeError("no actor hosted on this worker")
+        name = f"{self.actor_class_name}.{method_name}"
+        start = time.time()
+        error = ""
+
+        def run_sync():
+            err = ""
+            try:
+                args, kwargs = self._resolve(args_blob)
+                m = getattr(self.actor_instance, method_name)
+                result = m(*args, **kwargs)
+                self._store_returns(task_id, num_returns, result)
+            except BaseException as e:  # noqa: BLE001
+                err = repr(e)
+                self._fail_returns(task_id, num_returns, e, name)
+            return err
+
+        if self.actor_is_async:
+            # Ordered start, concurrent awaits (parity: async actors).
+            async def run_async():
+                err = ""
+                try:
+                    loop = asyncio.get_running_loop()
+                    args, kwargs = await loop.run_in_executor(
+                        None, lambda: self._resolve(args_blob))
+                    m = getattr(self.actor_instance, method_name)
+                    result = m(*args, **kwargs)
+                    if inspect.isawaitable(result):
+                        result = await result
+                    self._store_returns(task_id, num_returns, result)
+                except BaseException as e:  # noqa: BLE001
+                    err = repr(e)
+                    self._fail_returns(task_id, num_returns, e, name)
+                return err
+
+            self._wait_turn(caller_id, seqno)
+            asyncio.run_coroutine_threadsafe(run_async(), self.actor_loop)
+            self._done_turn(caller_id, seqno)
+            # Ack on enqueue: concurrent awaits must overlap, so completion
+            # is observed through the object store, not this reply.
+            return {"ok": True, "enqueued": True}
+        elif self.actor_pool is not None:
+            # max_concurrency > 1: out-of-order execution is allowed
+            # (parity: out_of_order_actor_scheduling_queue.h).
+            self._wait_turn(caller_id, seqno)
+            self.actor_pool.submit(run_sync)
+            self._done_turn(caller_id, seqno)
+            return {"ok": True, "enqueued": True}
+        else:
+            self._wait_turn(caller_id, seqno)
+            try:
+                error = run_sync()
+            finally:
+                self._done_turn(caller_id, seqno)
+        self.events.record(task_id, name, "actor_task", start, time.time(),
+                           error)
+        return {"ok": True}
+
+    def rpc_kill_actor(self, actor_id: bytes) -> dict:
+        self.events.flush()
+        try:
+            get_client(self.daemon_address).call("actor_exited",
+                                                 actor_id=actor_id)
+        except Exception:
+            pass
+        self._shutdown.set()
+        threading.Timer(0.1, lambda: os._exit(0)).start()
+        return {"ok": True}
+
+    def rpc_ping(self) -> str:
+        return "pong"
+
+    def rpc_exit(self) -> dict:
+        self._shutdown.set()
+        threading.Timer(0.05, lambda: os._exit(0)).start()
+        return {"ok": True}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--conductor", required=True)
+    ap.add_argument("--daemon", required=True)
+    ap.add_argument("--store-socket", required=True)
+    ap.add_argument("--store-prefix", required=True)
+    ap.add_argument("--node-id", required=True)
+    ap.add_argument("--token", required=True)
+    args = ap.parse_args()
+    node_id = bytes.fromhex(args.node_id)
+    svc = WorkerService(args.conductor, args.daemon, args.store_socket,
+                        args.store_prefix, node_id)
+    server = RpcServer(svc)
+    svc.address = server.address
+    # Connect the in-process public API so user code can submit nested work.
+    from ray_tpu.core import api
+    from ray_tpu.core.runtime_cluster import ClusterRuntime
+    api._runtime = ClusterRuntime.for_worker(
+        conductor_address=args.conductor, daemon_address=args.daemon,
+        store=svc.store, plane=svc.plane, node_id=node_id)
+    get_client(args.daemon).call(
+        "register_worker", token=args.token,
+        worker_id=svc.worker_id.binary(), address=server.address,
+        pid=os.getpid())
+    svc._shutdown.wait()
+
+
+if __name__ == "__main__":
+    main()

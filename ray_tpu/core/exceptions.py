@@ -60,5 +60,9 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    pass
+
+
 class PlacementGroupUnschedulableError(RayTpuError):
     pass

@@ -1,0 +1,791 @@
+"""ClusterRuntime: the distributed runtime behind the public API.
+
+Role parity: the submission half of the core worker —
+CoreWorker::SubmitTask (core_worker.cc:1876) via the lease-based direct
+task submitter (transport/direct_task_transport.h:75: request a worker
+lease from a node daemon, push tasks directly to the leased worker, reuse
+the lease for equal scheduling keys), CoreWorker::SubmitActorTask
+(core_worker.cc:2177) via an ordered per-actor pusher
+(transport/direct_actor_task_submitter.h:67: client-side sequence numbers,
+queueing across restarts), Get/Put over the shm object plane
+(core_worker.cc:1095/:1307), task retries (task_manager.h:90) and
+lineage-based object reconstruction (object_recovery_manager.h:106).
+
+Runs in three modes:
+- head: starts a Conductor + a NodeDaemon in-process, then connects.
+- client: connects to an existing conductor; if no node daemon runs on
+  this host, joins as a zero-CPU "driver node" so the driver has an
+  object store and a transfer endpoint.
+- worker (``for_worker``): inside worker processes, sharing the worker's
+  store connection, so user code can submit nested tasks/actors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import config
+from ray_tpu.cluster.object_plane import ObjectPlane
+from ray_tpu.cluster.protocol import ConnectionLost, RpcError, get_client
+from ray_tpu.core import serialization
+from ray_tpu.core.actor import ActorHandle
+from ray_tpu.core.exceptions import (ActorDiedError, GetTimeoutError,
+                                     ObjectLostError, TaskCancelledError,
+                                     TaskError)
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.options import ActorOptions, TaskOptions
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu.core.task_spec import FunctionDescriptor
+
+_LEASE_LINGER_S = 0.25     # idle lease kept briefly for reuse
+_MAX_LEASES_PER_KEY = 64
+
+
+class _LeasedWorker:
+    def __init__(self, lease_id: str, address: str, daemon_address: str):
+        self.lease_id = lease_id
+        self.address = address
+        self.daemon_address = daemon_address
+        self.alive = True
+
+
+class _KeyState:
+    """Per-scheduling-key lease pool + task queue."""
+
+    def __init__(self):
+        self.idle: deque = deque()           # _LeasedWorker
+        self.queue: deque = deque()          # task dicts
+        self.busy = 0
+        self.pending_leases = 0
+        self.lock = threading.Lock()
+
+
+class _TaskRecord:
+    __slots__ = ("task", "retries_left", "done")
+
+    def __init__(self, task: dict, retries_left: int):
+        self.task = task
+        self.retries_left = retries_left
+        self.done = False
+
+
+class TaskSubmitter:
+    """Normal-task path: leases + direct push (direct_task_transport.h:75)."""
+
+    def __init__(self, rt: "ClusterRuntime"):
+        self.rt = rt
+        self._keys: Dict[tuple, _KeyState] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=64,
+                                        thread_name_prefix="submit")
+        # lineage: return-oid -> _TaskRecord for reconstruction
+        self._lineage: Dict[bytes, _TaskRecord] = {}
+
+    def _key_state(self, key: tuple) -> _KeyState:
+        with self._lock:
+            st = self._keys.get(key)
+            if st is None:
+                st = self._keys[key] = _KeyState()
+            return st
+
+    def submit(self, task: dict) -> None:
+        rec = _TaskRecord(task, task["max_retries"])
+        for i in range(task["num_returns"]):
+            oid = TaskID(task["task_id"]).object_id_for_return(i)
+            self._lineage[oid.binary()] = rec
+            if len(self._lineage) > 20000:
+                # bounded lineage (parity: max_lineage_bytes budget)
+                self._lineage.pop(next(iter(self._lineage)))
+        self._enqueue(rec)
+
+    def _enqueue(self, rec: _TaskRecord) -> None:
+        st = self._key_state(rec.task["key"])
+        with st.lock:
+            st.queue.append(rec)
+        self._pump(st)
+
+    def _pump(self, st: _KeyState) -> None:
+        """Dispatch queued tasks onto idle leases; grow the pool if short."""
+        while True:
+            with st.lock:
+                if not st.queue:
+                    return
+                if st.idle:
+                    w = st.idle.popleft()
+                    rec = st.queue.popleft()
+                    st.busy += 1
+                else:
+                    need = len(st.queue)
+                    have = st.busy + len(st.idle) + st.pending_leases
+                    if have < min(need + st.busy, _MAX_LEASES_PER_KEY):
+                        st.pending_leases += 1
+                        rec0 = st.queue[0]
+                        self._pool.submit(self._acquire_lease, st,
+                                          dict(rec0.task))
+                    return
+            self._pool.submit(self._run_on, st, w, rec)
+
+    def _acquire_lease(self, st: _KeyState, task: dict) -> None:
+        try:
+            w = self.rt._lease_worker(task["resources"], task["strategy"],
+                                      task.get("runtime_env"))
+        finally:
+            with st.lock:
+                st.pending_leases -= 1
+        if w is None:
+            # Couldn't lease anywhere right now; retry while work remains.
+            with st.lock:
+                still_needed = bool(st.queue)
+            if still_needed:
+                time.sleep(0.2)
+                with st.lock:
+                    st.pending_leases += 1
+                self._pool.submit(self._acquire_lease, st, task)
+            return
+        with st.lock:
+            st.idle.append(w)
+        self._pump(st)
+        # The queue may have drained while this lease was in flight; make
+        # sure an unused grant is eventually returned, or it would pin node
+        # resources forever.
+        threading.Timer(_LEASE_LINGER_S, self._maybe_release, (st, w)).start()
+
+    def _run_on(self, st: _KeyState, w: _LeasedWorker, rec: _TaskRecord) -> None:
+        task = rec.task
+        try:
+            get_client(w.address).call(
+                "push_task", task_id=task["task_id"],
+                function_id=task["function_id"],
+                function_blob=None, args_blob=task["args_blob"],
+                num_returns=task["num_returns"], name=task["name"])
+            rec.done = True
+        except (ConnectionLost, OSError, RpcError):
+            w.alive = False
+            self.rt._drop_lease(w)
+            with st.lock:
+                st.busy -= 1
+            if rec.retries_left != 0:
+                if rec.retries_left > 0:
+                    rec.retries_left -= 1
+                self._enqueue(rec)
+            else:
+                err = TaskError.from_exception(
+                    ObjectLostError(task["task_id"].hex(),
+                                    "worker died and no retries left"),
+                    task["name"])
+                self.rt._store_error_returns(task, err)
+            return
+        except BaseException as e:  # noqa: BLE001 - surfaced via refs
+            with st.lock:
+                st.busy -= 1
+            self.rt._store_error_returns(task, TaskError.from_exception(
+                e, task["name"]))
+            self._return_worker(st, w)
+            return
+        with st.lock:
+            st.busy -= 1
+        self._return_worker(st, w)
+
+    def _return_worker(self, st: _KeyState, w: _LeasedWorker) -> None:
+        if not w.alive:
+            return
+        with st.lock:
+            st.idle.append(w)
+            has_work = bool(st.queue)
+        if has_work:
+            self._pump(st)
+        else:
+            threading.Timer(_LEASE_LINGER_S, self._maybe_release, (st, w)).start()
+
+    def _maybe_release(self, st: _KeyState, w: _LeasedWorker) -> None:
+        with st.lock:
+            if st.queue or w not in st.idle:
+                return
+            st.idle.remove(w)
+        self.rt._release_lease(w)
+
+    # -- lineage reconstruction (object_recovery_manager.h:106) --------
+    def try_recover(self, oid: ObjectID) -> bool:
+        rec = self._lineage.get(oid.binary())
+        if rec is None or not rec.done:
+            return False
+        rec.done = False
+        rec.task = dict(rec.task)
+        self._enqueue(rec)
+        return True
+
+
+class _ActorClient:
+    """Ordered pusher for one actor (direct_actor_task_submitter.h:67)."""
+
+    def __init__(self, rt: "ClusterRuntime", actor_id: bytes, class_name: str):
+        self.rt = rt
+        self.actor_id = actor_id
+        self.class_name = class_name
+        self.seqno = 0
+        self.incarnation = -1
+        self.address: Optional[str] = None
+        self.queue: deque = deque()
+        self.cv = threading.Condition()
+        self.dead = False
+        self.death_error: Optional[TaskError] = None
+        self.thread = threading.Thread(
+            target=self._push_loop, daemon=True,
+            name=f"actor-push-{actor_id.hex()[:8]}")
+        self.thread.start()
+
+    def submit(self, task: dict) -> None:
+        with self.cv:
+            if self.dead:
+                pass  # fail below, outside the lock
+            else:
+                self.queue.append(task)
+                self.cv.notify()
+                return
+        self.rt._store_error_returns(task, self.death_error)
+
+    def _push_loop(self) -> None:
+        while True:
+            with self.cv:
+                while not self.queue and not self.dead:
+                    self.cv.wait(1.0)
+                if self.dead:
+                    pending = list(self.queue)
+                    self.queue.clear()
+                    for t in pending:
+                        self.rt._store_error_returns(t, self.death_error)
+                    return
+                task = self.queue.popleft()
+            self._push_one(task)
+
+    def _resolve_address(self, timeout: float = 300.0) -> bool:
+        info = self.rt.conductor.call("get_actor_info",
+                                      actor_id=self.actor_id,
+                                      wait_alive_timeout=timeout)
+        if info["state"] == "ALIVE":
+            if info["incarnation"] != self.incarnation:
+                self.incarnation = info["incarnation"]
+                self.seqno = 0
+            self.address = info["address"]
+            return True
+        if info["state"] == "DEAD":
+            err = info.get("creation_error")
+            if err is not None:
+                exc = serialization.loads(err)
+                self.death_error = exc if isinstance(exc, TaskError) else \
+                    TaskError.from_exception(exc, self.class_name)
+            else:
+                self.death_error = TaskError.from_exception(
+                    ActorDiedError(self.class_name,
+                                   info.get("death_reason", "")),
+                    self.class_name)
+            with self.cv:
+                self.dead = True
+                self.cv.notify_all()
+            return False
+        return False
+
+    def _push_one(self, task: dict, attempt: int = 0) -> None:
+        while self.address is None:
+            if not self._resolve_address():
+                if self.dead:
+                    self.rt._store_error_returns(task, self.death_error)
+                    return
+                continue
+        seq = self.seqno
+        self.seqno += 1
+        try:
+            get_client(self.address).call(
+                "push_actor_task", task_id=task["task_id"],
+                caller_id=self.rt.caller_id, seqno=seq,
+                method_name=task["method_name"],
+                args_blob=task["args_blob"],
+                num_returns=task["num_returns"])
+        except (ConnectionLost, OSError, RpcError):
+            # Actor worker unreachable: consult the conductor FSM.
+            self.address = None
+            max_task_retries = task.get("max_task_retries", 0)
+            if max_task_retries != 0 and attempt < max(1, max_task_retries):
+                self._push_one(task, attempt + 1)
+            else:
+                self.rt._store_error_returns(
+                    task, TaskError.from_exception(
+                        ActorDiedError(self.class_name,
+                                       "actor worker unreachable"),
+                        f"{self.class_name}.{task['method_name']}"))
+
+
+class ClusterRuntime:
+    def __init__(self, address: Optional[str] = None,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 namespace: Optional[str] = None,
+                 object_store_bytes: int = 1 << 30):
+        from ray_tpu.cluster import object_client
+        self.namespace = namespace or "default"
+        self.job_id = JobID.from_random()
+        self.caller_id = WorkerID.from_random().binary()
+        self._owned_conductor = None
+        self._owned_daemon = None
+        if address is None:
+            # Head mode: bring up the control plane + head node daemon.
+            from ray_tpu.cluster.conductor import Conductor
+            from ray_tpu.cluster.node_daemon import NodeDaemon
+            total = self._default_resources(num_cpus, num_tpus, resources)
+            self._owned_conductor = Conductor()
+            self.conductor_address = self._owned_conductor.address
+            self._owned_daemon = NodeDaemon(
+                self.conductor_address, resources=total, is_head=True,
+                object_store_bytes=object_store_bytes)
+            daemon = self._owned_daemon
+        else:
+            self.conductor_address = address
+            daemon = None
+        self.conductor = get_client(self.conductor_address)
+        if daemon is None:
+            daemon_info = self._find_local_daemon()
+            if daemon_info is None:
+                from ray_tpu.cluster.node_daemon import NodeDaemon
+                self._owned_daemon = NodeDaemon(
+                    self.conductor_address, resources={"CPU": 0.0},
+                    object_store_bytes=object_store_bytes)
+                self.daemon_address = self._owned_daemon.address
+                self.node_id = self._owned_daemon.node_id
+                store_socket = self._owned_daemon.store_socket
+                store_prefix = self._owned_daemon.store_prefix
+            else:
+                self.daemon_address = daemon_info["address"]
+                self.node_id = daemon_info["node_id"]
+                store_socket = daemon_info["store_socket"]
+                store_prefix = f"rtpu-{self.node_id.hex()[:8]}-"
+            self.store = object_client.ShmClient(store_socket, store_prefix)
+        else:
+            self.daemon_address = daemon.address
+            self.node_id = daemon.node_id
+            self.store = object_client.ShmClient(daemon.store_socket,
+                                                 daemon.store_prefix)
+        self.plane = ObjectPlane(self.store, self.node_id,
+                                 self.conductor_address)
+        self._finish_init()
+
+    @staticmethod
+    def _default_resources(num_cpus, num_tpus, resources):
+        import multiprocessing
+        total = {"CPU": float(num_cpus if num_cpus is not None
+                              else multiprocessing.cpu_count())}
+        if num_tpus is None:
+            try:
+                from ray_tpu.tpu.topology import local_chip_count
+                num_tpus = local_chip_count()
+            except Exception:
+                num_tpus = 0
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        total.update(resources or {})
+        return total
+
+    def _find_local_daemon(self) -> Optional[dict]:
+        import os
+        for n in self.conductor.call("get_nodes"):
+            if n["alive"] and os.path.exists(n["store_socket"]):
+                return n
+        return None
+
+    @classmethod
+    def for_worker(cls, conductor_address: str, daemon_address: str,
+                   store, plane, node_id: bytes) -> "ClusterRuntime":
+        self = cls.__new__(cls)
+        self.namespace = "default"
+        self.job_id = JobID.from_random()
+        self.caller_id = WorkerID.from_random().binary()
+        self._owned_conductor = None
+        self._owned_daemon = None
+        self.conductor_address = conductor_address
+        self.conductor = get_client(conductor_address)
+        self.daemon_address = daemon_address
+        self.node_id = node_id
+        self.store = store
+        self.plane = plane
+        self._finish_init()
+        return self
+
+    def _finish_init(self) -> None:
+        self._registered_fns: set = set()
+        self._fn_lock = threading.Lock()
+        self.submitter = TaskSubmitter(self)
+        self._actor_clients: Dict[bytes, _ActorClient] = {}
+        self._actor_meta: Dict[bytes, dict] = {}
+        self._oid_actor: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        self.address = self.conductor_address
+
+    # ------------------------------------------------------------------
+    # leases (used by TaskSubmitter)
+    # ------------------------------------------------------------------
+    def _daemon_for_node(self, node_id: bytes) -> Optional[str]:
+        for n in self.conductor.call("get_nodes"):
+            if n["node_id"] == node_id and n["alive"]:
+                return n["address"]
+        return None
+
+    def _lease_worker(self, resources: Dict[str, float], strategy: Any,
+                      runtime_env: Optional[dict]) -> Optional[_LeasedWorker]:
+        """Locality-preferring lease acquisition with spillback (parity:
+        lease_policy.cc + spillback replies of HandleRequestWorkerLease)."""
+        targets: List[str] = []
+        if isinstance(strategy, dict) and strategy.get("type") == "pg":
+            pg = self.conductor.call("pg_ready", pg_id=strategy["pg_id"],
+                                     timeout=30.0)
+            if pg["state"] != "CREATED":
+                return None
+            idx = strategy.get("bundle_index", 0)
+            nodes = pg["bundle_nodes"]
+            candidates = ([nodes[idx]] if idx >= 0
+                          else list(dict.fromkeys(nodes)))
+            for nid in candidates:
+                addr = self._daemon_for_node(nid)
+                if addr:
+                    targets.append(addr)
+        elif isinstance(strategy, dict) and strategy.get("type") == "node":
+            addr = self._daemon_for_node(strategy["node_id"])
+            if addr:
+                targets.append(addr)
+            if not addr and not strategy.get("soft"):
+                return None
+        if not targets:
+            targets = [self.daemon_address]
+            nodes = sorted(
+                (n for n in self.conductor.call("get_nodes")
+                 if n["alive"] and n["address"] != self.daemon_address),
+                key=lambda n: -sum(n["resources_available"].get(k, 0.0)
+                                   for k in ("CPU", "TPU")))
+            targets += [n["address"] for n in nodes]
+        for addr in targets:
+            try:
+                resp = get_client(addr).call(
+                    "request_lease", resources=resources,
+                    runtime_env=runtime_env, strategy=strategy,
+                    wait_timeout=1.0 if addr == targets[-1] else 0.3)
+            except Exception:
+                continue
+            if resp.get("granted"):
+                return _LeasedWorker(resp["lease_id"],
+                                     resp["worker_address"], addr)
+        return None
+
+    def _release_lease(self, w: _LeasedWorker) -> None:
+        try:
+            get_client(w.daemon_address).call("return_lease",
+                                              lease_id=w.lease_id)
+        except Exception:
+            pass
+
+    def _drop_lease(self, w: _LeasedWorker) -> None:
+        self._release_lease(w)
+
+    # ------------------------------------------------------------------
+    # objects
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        self.plane.put_value(oid, value)
+        return ObjectRef(oid, owner=self.address)
+
+    def _store_error_returns(self, task: dict, err: TaskError) -> None:
+        tid = TaskID(task["task_id"])
+        for i in range(task["num_returns"]):
+            oid = tid.object_id_for_return(i)
+            try:
+                self.plane.put_value(oid, err)
+            except Exception:
+                pass
+
+    def get(self, refs: List[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            out.append(self._get_one(ref, deadline))
+        return out
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        recover_attempted = False
+        waited = 0.0
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(f"Get timed out waiting for {ref}")
+            step = 2.0 if remaining is None else min(2.0, remaining)
+            try:
+                value = self.plane.get_value(ref.id, timeout=step)
+            except GetTimeoutError:
+                waited += step
+                # Object not ready: maybe its actor died, or it was lost
+                # and lineage can reconstruct it.
+                actor_id = self._oid_actor.get(ref.id.binary())
+                if actor_id is not None:
+                    info = self.conductor.call("get_actor_info",
+                                               actor_id=actor_id)
+                    if info["state"] == "DEAD":
+                        cli = self._actor_clients.get(actor_id)
+                        if cli and cli.death_error:
+                            raise cli.death_error
+                        raise TaskError.from_exception(
+                            ActorDiedError(info.get("class_name", ""),
+                                           info.get("death_reason", "")))
+                elif waited >= 4.0 and not recover_attempted:
+                    recover_attempted = True
+                    self.submitter.try_recover(ref.id)
+                continue
+            if isinstance(value, TaskError):
+                raise value
+            return value
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while True:
+            still = []
+            for r in pending:
+                if len(ready) < num_returns and self.plane.contains(r.id):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, [r for r in refs if r not in set(ready)]
+
+    # ------------------------------------------------------------------
+    # tasks
+    # ------------------------------------------------------------------
+    def _register_function(self, desc: FunctionDescriptor, blob: bytes) -> None:
+        with self._fn_lock:
+            if desc.function_id in self._registered_fns:
+                return
+            self._registered_fns.add(desc.function_id)
+        self.conductor.call("put_function", function_id=desc.function_id,
+                            blob=blob)
+
+    def _strategy_dict(self, strategy: Any) -> Any:
+        if strategy is None:
+            return None
+        if isinstance(strategy, dict):
+            return strategy
+        # PlacementGroupSchedulingStrategy / NodeAffinitySchedulingStrategy
+        if hasattr(strategy, "placement_group"):
+            pg = strategy.placement_group
+            return {"type": "pg", "pg_id": pg.id.binary(),
+                    "bundle_index": getattr(
+                        strategy, "placement_group_bundle_index", 0) or 0}
+        if hasattr(strategy, "node_id"):
+            nid = strategy.node_id
+            if isinstance(nid, str):
+                nid = bytes.fromhex(nid)
+            elif isinstance(nid, NodeID):
+                nid = nid.binary()
+            return {"type": "node", "node_id": nid,
+                    "soft": getattr(strategy, "soft", False)}
+        return None
+
+    def submit_task(self, desc: FunctionDescriptor, blob: bytes, args, kwargs,
+                    opts: TaskOptions) -> List[ObjectRef]:
+        self._register_function(desc, blob)
+        task_id = TaskID.from_random()
+        args_blob = serialization.dumps((list(args), dict(kwargs)))
+        resources = {"CPU": opts.num_cpus, "TPU": opts.num_tpus,
+                     **opts.resources}
+        resources = {k: v for k, v in resources.items() if v > 0}
+        strategy = self._strategy_dict(opts.scheduling_strategy)
+        max_retries = opts.max_retries
+        if max_retries == -1:
+            max_retries = config.get("task_max_retries_default")
+        task = {
+            "task_id": task_id.binary(),
+            "function_id": desc.function_id,
+            "args_blob": args_blob,
+            "num_returns": opts.num_returns,
+            "resources": resources,
+            "strategy": strategy,
+            "runtime_env": opts.runtime_env,
+            "name": opts.name or desc.repr_name(),
+            "max_retries": max_retries,
+            "key": (desc.function_id, tuple(sorted(resources.items())),
+                    repr(strategy), repr(opts.runtime_env)),
+        }
+        self.submitter.submit(task)
+        return [ObjectRef(task_id.object_id_for_return(i), owner=self.address)
+                for i in range(opts.num_returns)]
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def create_actor(self, desc: FunctionDescriptor, blob: bytes, args, kwargs,
+                     opts: ActorOptions, methods: Dict[str, dict],
+                     is_async: bool) -> ActorHandle:
+        actor_id = ActorID.from_random()
+        args_blob = serialization.dumps((list(args), dict(kwargs)))
+        resources = {"CPU": opts.num_cpus, "TPU": opts.num_tpus,
+                     **opts.resources}
+        resources = {k: v for k, v in resources.items() if v > 0}
+        spec = {
+            "function_id": desc.function_id,
+            "class_blob": blob,
+            "class_name": desc.repr_name(),
+            "args_blob": args_blob,
+            "is_async": is_async,
+            "opts": {
+                "name": opts.name, "namespace": opts.namespace or self.namespace,
+                "max_restarts": opts.max_restarts,
+                "max_task_retries": opts.max_task_retries,
+                "max_concurrency": opts.max_concurrency,
+                "lifetime": opts.lifetime,
+                "get_if_exists": opts.get_if_exists,
+                "resources_req": resources or {"CPU": 1.0},
+                "scheduling_strategy": self._strategy_dict(
+                    opts.scheduling_strategy),
+                "runtime_env": opts.runtime_env,
+            },
+        }
+        resp = self.conductor.call("register_actor",
+                                   actor_id=actor_id.binary(), spec=spec)
+        if resp.get("existing") is not None:
+            return self._handle_for(resp["existing"])
+        with self._lock:
+            self._actor_meta[actor_id.binary()] = {
+                "methods": methods, "is_async": is_async,
+                "class_name": desc.repr_name(),
+                "max_task_retries": opts.max_task_retries,
+            }
+        return ActorHandle(actor_id, desc.repr_name(), methods, is_async)
+
+    def _handle_for(self, actor_id: bytes) -> ActorHandle:
+        meta = self._actor_meta.get(actor_id)
+        if meta is None:
+            info = self.conductor.call("get_actor_info", actor_id=actor_id)
+            meta = {"methods": {}, "is_async": False,
+                    "class_name": info.get("class_name", ""),
+                    "max_task_retries": 0}
+        return ActorHandle(ActorID(actor_id), meta["class_name"],
+                           meta["methods"], meta["is_async"])
+
+    def _actor_client(self, actor_id: bytes, class_name: str) -> _ActorClient:
+        with self._lock:
+            cli = self._actor_clients.get(actor_id)
+            if cli is None:
+                cli = _ActorClient(self, actor_id, class_name)
+                self._actor_clients[actor_id] = cli
+            return cli
+
+    def submit_actor_task(self, handle: ActorHandle, method_name: str, args,
+                          kwargs, opts: TaskOptions) -> List[ObjectRef]:
+        actor_id = handle._rt_actor_id.binary()
+        task_id = TaskID.from_random()
+        args_blob = serialization.dumps((list(args), dict(kwargs)))
+        meta = self._actor_meta.get(actor_id, {})
+        task = {
+            "task_id": task_id.binary(),
+            "method_name": method_name,
+            "args_blob": args_blob,
+            "num_returns": opts.num_returns,
+            "max_task_retries": meta.get("max_task_retries", 0),
+        }
+        refs = [ObjectRef(task_id.object_id_for_return(i), owner=self.address)
+                for i in range(opts.num_returns)]
+        with self._lock:
+            for r in refs:
+                self._oid_actor[r.id.binary()] = actor_id
+            if len(self._oid_actor) > 50000:
+                for k in list(self._oid_actor)[:10000]:
+                    del self._oid_actor[k]
+        self._actor_client(actor_id, handle._rt_class_name).submit(task)
+        return refs
+
+    def kill_actor(self, handle: ActorHandle, no_restart: bool = True) -> None:
+        self.conductor.call("kill_actor",
+                            actor_id=handle._rt_actor_id.binary(),
+                            no_restart=no_restart)
+
+    def get_actor(self, name: str, namespace: str = "") -> ActorHandle:
+        actor_id = self.conductor.call(
+            "get_named_actor", name=name,
+            namespace=namespace or self.namespace)
+        if actor_id is None:
+            raise ValueError(f"No actor named {name!r}")
+        return self._handle_for(actor_id)
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        rec = self.submitter._lineage.get(ref.id.binary())
+        if rec is not None and not rec.done:
+            self._store_error_returns(
+                rec.task, TaskError.from_exception(
+                    TaskCancelledError("task cancelled"), rec.task["name"]))
+
+    # ------------------------------------------------------------------
+    # placement groups (public surface lives in util/placement_group.py)
+    # ------------------------------------------------------------------
+    def create_placement_group(self, pg_id: bytes,
+                               bundles: List[Dict[str, float]],
+                               strategy: str, name: str = "") -> None:
+        self.conductor.call("create_placement_group", pg_id=pg_id,
+                            bundles=bundles, strategy=strategy, name=name)
+
+    def pg_ready(self, pg_id: bytes, timeout: float = 0.0) -> dict:
+        return self.conductor.call("pg_ready", pg_id=pg_id, timeout=timeout)
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        self.conductor.call("remove_placement_group", pg_id=pg_id)
+
+    # ------------------------------------------------------------------
+    # introspection / shutdown
+    # ------------------------------------------------------------------
+    def nodes(self) -> List[dict]:
+        return [{
+            "NodeID": n["node_id"].hex(),
+            "Alive": n["alive"],
+            "Resources": n["resources_total"],
+            "Available": n["resources_available"],
+            "address": n["address"],
+            "is_head": n["is_head"],
+        } for n in self.conductor.call("get_nodes")]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.conductor.call("cluster_resources")
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.conductor.call("available_resources")
+
+    def timeline_events(self) -> List[dict]:
+        raw = self.conductor.call("get_task_events")
+        return [{
+            "cat": e["kind"], "name": e["name"], "ph": "X",
+            "ts": e["start"] * 1e6, "dur": (e["end"] - e["start"]) * 1e6,
+            "pid": e["node_id"][:8], "tid": e["pid"],
+            "args": {"error": e["error"]},
+        } for e in raw]
+
+    def list_actors(self) -> List[dict]:
+        return self.conductor.call("list_actors")
+
+    def shutdown(self) -> None:
+        if self._owned_daemon is not None:
+            try:
+                self._owned_daemon.stop()
+            except Exception:
+                pass
+        if self._owned_conductor is not None:
+            try:
+                self._owned_conductor.stop()
+            except Exception:
+                pass

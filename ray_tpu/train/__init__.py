@@ -13,18 +13,32 @@ from ray_tpu.train.jax_step import (
     make_resnet_train_step,
 )
 
-__all__ = ["TrainState", "make_lm_train_step", "make_resnet_train_step"]
+_LAZY = {
+    "ScalingConfig": ("ray_tpu.air.config", "ScalingConfig"),
+    "RunConfig": ("ray_tpu.air.config", "RunConfig"),
+    "CheckpointConfig": ("ray_tpu.air.config", "CheckpointConfig"),
+    "FailureConfig": ("ray_tpu.air.config", "FailureConfig"),
+    "Checkpoint": ("ray_tpu.air.checkpoint", "Checkpoint"),
+    "Result": ("ray_tpu.air.result", "Result"),
+    "session": ("ray_tpu.air", "session"),
+    "report": ("ray_tpu.air.session", "report"),
+    "JaxTrainer": ("ray_tpu.train.trainer", "JaxTrainer"),
+    "DataParallelTrainer": ("ray_tpu.train.trainer", "DataParallelTrainer"),
+    "BaseTrainer": ("ray_tpu.train.trainer", "BaseTrainer"),
+    "BackendExecutor": ("ray_tpu.train.backend_executor", "BackendExecutor"),
+    "JaxBackend": ("ray_tpu.train.backend_executor", "JaxBackend"),
+    "WorkerGroup": ("ray_tpu.train.worker_group", "WorkerGroup"),
+}
+
+__all__ = ["TrainState", "make_lm_train_step", "make_resnet_train_step",
+           *_LAZY]
 
 
 def __getattr__(name):
     # Heavier trainer machinery is imported lazily so `import ray_tpu.train`
     # stays light for pure-step users.
-    if name in ("ScalingConfig", "RunConfig", "CheckpointConfig",
-                "FailureConfig", "Checkpoint", "JaxTrainer",
-                "DataParallelTrainer", "report", "get_context"):
-        try:
-            from ray_tpu.train import trainer as _t
-        except ModuleNotFoundError as e:
-            raise AttributeError(name) from e
-        return getattr(_t, name)
-    raise AttributeError(name)
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(name)
+    import importlib
+    return getattr(importlib.import_module(entry[0]), entry[1])

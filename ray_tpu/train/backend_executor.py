@@ -1,0 +1,150 @@
+"""BackendExecutor: orchestrates the training gang.
+
+Role parity: python/ray/train/_internal/backend_executor.py:43 — start the
+WorkerGroup, run the backend's on_start (rendezvous), start the user loop on
+every worker, then pump reports until all ranks finish.
+
+The JaxBackend replaces the reference's _TorchBackend
+(train/torch/config.py:155): instead of dist.init_process_group(nccl), it
+seeds ``jax.distributed.initialize`` with a coordinator on rank 0
+(coordination-service rendezvous; collectives then compile into the step
+function and ride ICI) — SURVEY.md §3.4 "TPU mapping".
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class Backend:
+    def on_start(self, worker_group: WorkerGroup) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup) -> None:
+        pass
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int,
+                          process_id: int) -> bool:
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+class JaxBackend(Backend):
+    """Multi-process SPMD rendezvous (parity role: _TorchBackend)."""
+
+    def __init__(self, distributed: bool = True):
+        self.distributed = distributed
+
+    def on_start(self, worker_group: WorkerGroup) -> None:
+        if not self.distributed or worker_group.num_workers == 1:
+            return
+        # Rank 0's host picks the coordinator port; every rank calls
+        # jax.distributed.initialize against it (replaces NCCL unique-id
+        # rendezvous through the GCS KV, reference nccl_util.py).
+        ip = worker_group.execute_single(
+            0, lambda: socket.gethostbyname(socket.gethostname()))
+        port = worker_group.execute_single(0, _free_port)
+        coordinator = f"{ip}:{port}"
+        import ray_tpu as rt
+        refs = [
+            w.execute.remote(_init_jax_distributed, coordinator,
+                             worker_group.num_workers, rank)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        rt.get(refs, timeout=120)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, backend: Backend, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_strategy: str = "PACK"):
+        self.backend = backend
+        self.num_workers = num_workers
+        self.resources_per_worker = resources_per_worker
+        self.placement_strategy = placement_strategy
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            self.num_workers, self.resources_per_worker,
+            self.placement_strategy)
+        self.backend.on_start(self.worker_group)
+
+    def run(self, train_loop: Callable, config: dict,
+            on_report: Callable[[dict], Any],
+            trial_dir: str = "",
+            checkpoint: Optional[Checkpoint] = None) -> List[dict]:
+        """Start the loop on all ranks and pump synchronized reports.
+
+        ``on_report`` receives the merged report each round (rank-0 metrics
+        + rank-0 checkpoint); returning "stop" requests cooperative stop.
+        Returns the full merged report history.
+        """
+        import ray_tpu as rt
+        wg = self.worker_group
+        rt.get([w.start_training.remote(train_loop, config, trial_dir,
+                                        checkpoint)
+                for w in wg.workers], timeout=600)
+        history: List[dict] = []
+        index = 0
+        finished = False
+        while not finished:
+            # One synchronized round: wait for report[index] on every rank
+            # (session.report is a barrier in the reference's semantics).
+            round_reports: List[Optional[dict]] = [None] * len(wg.workers)
+            pending = set(range(len(wg.workers)))
+            while pending:
+                for rank in list(pending):
+                    r = rt.get(wg.workers[rank].next_report.remote(
+                        index, 30.0), timeout=120)
+                    if r["status"] == "report":
+                        round_reports[rank] = r
+                        pending.discard(rank)
+                    elif r["status"] == "finished":
+                        round_reports[rank] = None
+                        pending.discard(rank)
+                        finished = True
+                    elif r["status"] == "error":
+                        raise TrainingFailedError(r["traceback"])
+                    # "pending": poll again
+            if all(r is None for r in round_reports):
+                break
+            rank0 = round_reports[0]
+            if rank0 is not None:
+                merged = {"metrics": rank0["metrics"],
+                          "checkpoint": rank0["checkpoint"],
+                          "iteration": rank0["iteration"]}
+                history.append(merged)
+                if on_report(merged) == "stop":
+                    for w in wg.workers:
+                        w.request_stop.remote()
+                    finished = True
+            index += 1
+        return history
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self.worker_group)
+            self.worker_group.shutdown()
+            self.worker_group = None

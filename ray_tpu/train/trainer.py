@@ -1,0 +1,142 @@
+"""Trainers: BaseTrainer / DataParallelTrainer / JaxTrainer.
+
+Role parity: python/ray/train/base_trainer.py:554 (BaseTrainer.fit),
+data_parallel_trainer.py:56 (DataParallelTrainer -> BackendExecutor ->
+WorkerGroup), torch/torch_trainer.py:15 (framework trainer). The reference
+routes fit() through a single-trial Tune run (base_trainer.py:579); here
+fit() drives the BackendExecutor directly, and ray_tpu.tune.Tuner wraps a
+trainer the same way when sweeping.
+
+TPU-first: the framework trainer is JaxTrainer — the user loop builds a
+mesh from ScalingConfig.mesh and a pjit step; on multi-host gangs
+JaxBackend has already done jax.distributed.initialize, so
+jax.devices() spans the slice and the same pjit code scales (SURVEY §3.4).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend_executor import (Backend, BackendExecutor,
+                                            JaxBackend, TrainingFailedError)
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self) -> Callable[[dict], Result]:
+        """A Tune-compatible trainable closing over this trainer (parity:
+        base_trainer.py:666 as_trainable)."""
+        trainer = self
+
+        def trainable(config: dict) -> Result:
+            import copy
+            t = copy.copy(trainer)
+            merged = dict(getattr(t, "train_loop_config", None) or {})
+            merged.update(config)
+            t.train_loop_config = merged
+            return t.fit()
+
+        return trainable
+
+
+class DataParallelTrainer(BaseTrainer):
+    """N identical workers running one loop (parity:
+    data_parallel_trainer.py:56)."""
+
+    _backend_cls: Callable[[], Backend] = Backend
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 backend: Optional[Backend] = None):
+        super().__init__(scaling_config=scaling_config, run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend = backend or self._backend_cls()
+
+    def fit(self) -> Result:
+        cfg = self.run_config
+        trial_dir = os.path.join(
+            cfg.storage_path or tempfile.gettempdir(),
+            cfg.name or "rtpu_train")
+        os.makedirs(trial_dir, exist_ok=True)
+        stop = cfg.stop or {}
+        failure = cfg.failure_config or FailureConfig()
+        attempts = 0
+        while True:
+            executor = BackendExecutor(
+                self.backend, self.scaling_config.num_workers,
+                self.scaling_config.worker_resources(),
+                self.scaling_config.placement_strategy)
+            state = {"last_metrics": {}, "last_checkpoint":
+                     self.resume_from_checkpoint, "history": []}
+
+            def on_report(merged):
+                state["last_metrics"] = merged["metrics"]
+                state["history"].append(merged["metrics"])
+                if merged["checkpoint"] is not None:
+                    state["last_checkpoint"] = merged["checkpoint"]
+                for key, bound in stop.items():
+                    if key == "training_iteration":
+                        if merged["iteration"] >= bound:
+                            return "stop"
+                    elif merged["metrics"].get(key) is not None and \
+                            merged["metrics"][key] >= bound:
+                        return "stop"
+                return None
+
+            try:
+                executor.start()
+                executor.run(self.train_loop_per_worker,
+                             self.train_loop_config, on_report,
+                             trial_dir=trial_dir,
+                             checkpoint=state["last_checkpoint"])
+                return Result(metrics=state["last_metrics"],
+                              checkpoint=state["last_checkpoint"],
+                              metrics_history=state["history"],
+                              config=dict(self.train_loop_config),
+                              path=trial_dir)
+            except TrainingFailedError as e:
+                attempts += 1
+                if failure.max_failures != -1 and \
+                        attempts > failure.max_failures:
+                    return Result(metrics=state["last_metrics"],
+                                  checkpoint=state["last_checkpoint"],
+                                  metrics_history=state["history"],
+                                  error=e,
+                                  config=dict(self.train_loop_config),
+                                  path=trial_dir)
+                # elastic restart from the last checkpoint (SURVEY §5:
+                # a lost host kills the XLA program; recovery = re-form
+                # the gang + checkpoint restore, not per-task retry)
+                self.resume_from_checkpoint = state["last_checkpoint"]
+            finally:
+                executor.shutdown()
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The framework trainer (role of TorchTrainer, torch_trainer.py:15)."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 distributed: bool = True, **kwargs):
+        super().__init__(train_loop_per_worker,
+                         backend=JaxBackend(distributed=distributed),
+                         **kwargs)

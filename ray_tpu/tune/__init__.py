@@ -1,0 +1,21 @@
+"""ray_tpu.tune — hyperparameter sweep library.
+
+Parity surface: reference python/ray/tune — Tuner (tuner.py:53),
+TrialRunner/TuneController (execution/trial_runner.py:1179), search spaces
+(grid_search/choice/uniform/...), schedulers (FIFO, ASHA
+schedulers/async_hyperband.py, median stopping, PBT pbt.py), ResultGrid.
+"""
+
+from ray_tpu.tune.search_space import (choice, grid_search, loguniform,
+                                       randint, randn, uniform, sample_from)
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.tuner import (ResultGrid, TuneConfig, Tuner, run)
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = ["Tuner", "TuneConfig", "ResultGrid", "run", "grid_search",
+           "choice", "uniform", "loguniform", "randint", "randn",
+           "sample_from", "FIFOScheduler", "AsyncHyperBandScheduler",
+           "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining"]

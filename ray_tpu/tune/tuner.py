@@ -1,0 +1,240 @@
+"""Tuner + trial controller.
+
+Role parity: python/ray/tune/tuner.py:53 (Tuner.fit -> ResultGrid),
+execution/tune_controller.py:47 (trial loop). Trials run as remote tasks
+holding their declared resources; intermediate ``session.report`` results
+stream through a _TrialBoard actor, where the scheduler (ASHA/median/PBT)
+decides continue/stop per report — the same control point the reference
+gives schedulers via TrialRunner.on_trial_result.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.tune.schedulers import (CONTINUE, FIFOScheduler,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search_space import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: int = 0
+    resources_per_trial: Dict[str, float] = field(default_factory=dict)
+
+
+class _TrialBoard:
+    """Actor: collects streamed trial results + runs the scheduler."""
+
+    def __init__(self, scheduler_blob: bytes):
+        import pickle
+        self.scheduler: TrialScheduler = pickle.loads(scheduler_blob)
+        self.history: Dict[str, List[dict]] = {}
+
+    def report(self, trial_id: str, iteration: int, metrics: dict,
+               config: dict, has_checkpoint: bool, checkpoint=None) -> dict:
+        self.history.setdefault(trial_id, []).append(dict(metrics))
+        if isinstance(self.scheduler, PopulationBasedTraining):
+            self.scheduler.record_state(trial_id, config, checkpoint)
+        decision = self.scheduler.on_result(trial_id, iteration, metrics)
+        out = {"decision": decision}
+        if isinstance(self.scheduler, PopulationBasedTraining):
+            exploit = self.scheduler.pop_exploit(trial_id)
+            if exploit is not None:
+                out["exploit"] = exploit
+        return out
+
+    def complete(self, trial_id: str) -> bool:
+        self.scheduler.on_trial_complete(trial_id)
+        return True
+
+    def get_history(self, trial_id: str) -> List[dict]:
+        return self.history.get(trial_id, [])
+
+
+def _run_trial(trainable, config: dict, trial_id: str, board,
+               trial_dir: str) -> dict:
+    """Executes one trial inside a worker, streaming reports to the board.
+
+    Function trainables use session.report; Trainer.as_trainable returns a
+    Result directly.
+    """
+    import ray_tpu as rtp
+    from ray_tpu.air import session as session_mod
+
+    os.makedirs(trial_dir, exist_ok=True)
+    sess = session_mod._Session(0, 1, 0, trial_dir=trial_dir, config=config)
+    session_mod._set_session(sess)
+    last_metrics: Dict[str, Any] = {}
+    last_checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+
+    real_report = sess.report
+
+    def hooked_report(metrics, checkpoint=None):
+        nonlocal last_metrics, last_checkpoint
+        last_metrics = dict(metrics)
+        if checkpoint is not None:
+            last_checkpoint = checkpoint
+        real_report(metrics, checkpoint=checkpoint)
+        resp = rtp.get(board.report.remote(
+            trial_id, sess.iteration, metrics, config,
+            checkpoint is not None, checkpoint))
+        if resp["decision"] != CONTINUE:
+            raise StopIteration("stopped by scheduler")
+        exploit = resp.get("exploit")
+        if exploit is not None:
+            # PBT exploit: adopt the better config (+checkpoint) in place.
+            config.update(exploit["config"])
+            sess.config = config
+            if exploit["checkpoint"] is not None:
+                sess.loaded_checkpoint = exploit["checkpoint"]
+
+    sess.report = hooked_report
+    try:
+        out = trainable(config)
+        if isinstance(out, Result):
+            last_metrics = out.metrics or last_metrics
+            last_checkpoint = out.checkpoint or last_checkpoint
+            if out.error is not None:
+                error = repr(out.error)
+        elif isinstance(out, dict):
+            last_metrics.update(out)
+    except StopIteration:
+        pass
+    except BaseException as e:  # noqa: BLE001 - recorded per-trial
+        import traceback
+        error = traceback.format_exc()
+    finally:
+        session_mod._set_session(None)
+        rtp.get(board.complete.remote(trial_id))
+    return {"trial_id": trial_id, "metrics": last_metrics,
+            "checkpoint": last_checkpoint, "config": config, "error": error}
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        scored = [r for r in self._results
+                  if r.error is None and metric in (r.metrics or {})]
+        if not scored:
+            raise RuntimeError("no successful trial reported the metric")
+        key = lambda r: r.metrics[metric]
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def get_dataframe(self):
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row.update({f"config/{k}": v for k, v in (r.config or {}).items()})
+            rows.append(row)
+        try:
+            import pandas as pd
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        from ray_tpu.train.trainer import BaseTrainer
+        if isinstance(trainable, BaseTrainer):
+            self._trainable = trainable.as_trainable()
+        else:
+            self._trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        import pickle
+
+        import ray_tpu as rtp
+        tc = self.tune_config
+        variants = generate_variants(self.param_space, tc.num_samples,
+                                     tc.seed)
+        exp_dir = os.path.join(
+            self.run_config.storage_path or tempfile.gettempdir(),
+            self.run_config.name or f"tune_{int(time.time())}")
+        os.makedirs(exp_dir, exist_ok=True)
+        scheduler = tc.scheduler or FIFOScheduler()
+        board_cls = rtp.remote(_TrialBoard)
+        board = board_cls.options(max_concurrency=16).remote(
+            pickle.dumps(scheduler))
+        res = dict(tc.resources_per_trial) or {"CPU": 1.0}
+        run_remote = rtp.remote(_run_trial).options(
+            num_cpus=res.get("CPU", 1.0), num_tpus=res.get("TPU", 0.0),
+            resources={k: v for k, v in res.items()
+                       if k not in ("CPU", "TPU")})
+        max_conc = tc.max_concurrent_trials or len(variants)
+        pending = []
+        results: List[Result] = []
+        queue = list(enumerate(variants))
+        inflight = {}
+        while queue or inflight:
+            while queue and len(inflight) < max_conc:
+                idx, cfg = queue.pop(0)
+                trial_id = f"trial_{idx:05d}"
+                ref = run_remote.remote(
+                    self._trainable, cfg, trial_id, board,
+                    os.path.join(exp_dir, trial_id))
+                inflight[ref] = trial_id
+            ready, _ = rtp.wait(list(inflight), num_returns=1, timeout=600)
+            for ref in ready:
+                inflight.pop(ref)
+                out = rtp.get(ref)
+                results.append(Result(
+                    metrics=out["metrics"], checkpoint=out["checkpoint"],
+                    error=RuntimeError(out["error"]) if out["error"] else None,
+                    config=out["config"],
+                    path=os.path.join(exp_dir, out["trial_id"])))
+        rtp.kill(board)
+        return ResultGrid(results, tc.metric, tc.mode)
+
+
+def run(trainable, *, config: Optional[dict] = None, num_samples: int = 1,
+        metric: Optional[str] = None, mode: str = "max",
+        scheduler: Optional[TrialScheduler] = None,
+        resources_per_trial: Optional[dict] = None, **_ignored) -> ResultGrid:
+    """Legacy-style entry point (parity: tune.run)."""
+    return Tuner(
+        trainable, param_space=config or {},
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler,
+                               resources_per_trial=resources_per_trial or {}),
+    ).fit()

@@ -1,0 +1,18 @@
+"""Utility layer: placement groups, scheduling strategies, actor pool,
+distributed queue (parity: python/ray/util/)."""
+
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          remove_placement_group,
+                                          placement_group_table)
+from ray_tpu.util.scheduling_strategies import (
+    PlacementGroupSchedulingStrategy, NodeAffinitySchedulingStrategy,
+    SliceSchedulingStrategy)
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Queue
+
+__all__ = [
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "placement_group_table", "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy", "SliceSchedulingStrategy",
+    "ActorPool", "Queue",
+]

@@ -1,0 +1,89 @@
+"""Placement groups (parity: python/ray/util/placement_group.py:33/:136).
+
+Bundles are reserved across node daemons with 2PC prepare/commit
+(conductor.py, reference gcs_placement_group_scheduler.h:265). The TPU-first
+strategy addition: STRICT_PACK on a TPU-labelled node keeps a whole pjit
+gang on one ICI slice.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.core.exceptions import GetTimeoutError
+from ray_tpu.core.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: List[Dict[str, float]], strategy: str,
+                 name: str = ""):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self.name = name
+
+    def ready(self, timeout: Optional[float] = None):
+        """Block until all bundles are reserved; returns self (the reference
+        returns an ObjectRef — here readiness is a control-plane wait)."""
+        from ray_tpu.core.api import _global_runtime
+        rt = _global_runtime()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            step = 5.0 if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            info = rt.pg_ready(self.id.binary(), timeout=min(step, 5.0))
+            if info["state"] == "CREATED":
+                return self
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(
+                    f"placement group {self.id.hex()} not ready "
+                    f"(state={info['state']})")
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        try:
+            self.ready(timeout=timeout_seconds)
+            return True
+        except GetTimeoutError:
+            return False
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs, self.strategy,
+                                 self.name))
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK", name: str = "",
+                    lifetime: Optional[str] = None) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"Invalid strategy {strategy!r}; "
+                         f"one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty "
+                         "resource dicts")
+    for b in bundles:
+        if any(v < 0 for v in b.values()):
+            raise ValueError("bundle resources must be non-negative")
+    from ray_tpu.core.api import _global_runtime
+    rt = _global_runtime()
+    pg_id = PlacementGroupID.from_random()
+    rt.create_placement_group(pg_id.binary(), bundles, strategy, name)
+    return PlacementGroup(pg_id, bundles, strategy, name)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu.core.api import _global_runtime
+    _global_runtime().remove_placement_group(pg.id.binary())
+
+
+def placement_group_table() -> List[dict]:
+    from ray_tpu.core.api import _global_runtime
+    rt = _global_runtime()
+    return rt.conductor.call("list_placement_groups")

@@ -1,0 +1,34 @@
+"""Scheduling strategies (parity: python/ray/util/scheduling_strategies.py:15).
+
+TPU-first delta: SliceSchedulingStrategy pins a task/actor group to an
+ICI-connected TPU slice (the placement group's bundles are slice-granular,
+SURVEY.md §2a N9 mapping note) rather than to arbitrary nodes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: "PlacementGroup"  # noqa: F821
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str
+    soft: bool = False
+
+
+@dataclass
+class SliceSchedulingStrategy:
+    """Gang-place onto one ICI slice: every bundle of the backing placement
+    group maps to hosts of the same TPU slice so the pjit program's
+    collectives ride ICI, not DCN."""
+    topology: str = ""              # e.g. "v4-8"; "" = any slice
+    placement_group: Optional["PlacementGroup"] = None  # noqa: F821
+    placement_group_bundle_index: int = -1

@@ -1,0 +1,108 @@
+"""Placement group + collective group tests (parity:
+python/ray/tests/test_placement_group*.py; util/collective tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group,
+                          placement_group_table, remove_placement_group)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.add_node(num_cpus=4)
+    c.wait_for_nodes(2)
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
+
+
+def test_pg_create_ready_remove(cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    pg.ready(timeout=20)
+    table = placement_group_table()
+    assert any(row["pg_id"] == pg.id.hex() and row["state"] == "CREATED"
+               for row in table)
+    remove_placement_group(pg)
+
+
+def test_pg_strict_spread_two_nodes(cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    pg.ready(timeout=20)
+
+    @rt.remote(num_cpus=1)
+    def node_of():
+        import ray_tpu
+        return ray_tpu.get_runtime_context().node_id.hex() \
+            if hasattr(ray_tpu.get_runtime_context(), "node_id") else ""
+
+    s0 = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    s1 = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=1)
+    n0 = rt.get(node_of.options(scheduling_strategy=s0).remote(), timeout=60)
+    n1 = rt.get(node_of.options(scheduling_strategy=s1).remote(), timeout=60)
+    assert n0 != n1  # STRICT_SPREAD put the bundles on distinct nodes
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible_strict_pack_times_out(cluster):
+    # 9 CPUs cannot STRICT_PACK onto 4-CPU nodes.
+    pg = placement_group([{"CPU": 9}], strategy="STRICT_PACK")
+    assert not pg.wait(timeout_seconds=2)
+    remove_placement_group(pg)
+
+
+def test_actor_in_placement_group(cluster):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    pg.ready(timeout=20)
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        pg, placement_group_bundle_index=0)).remote()
+    assert rt.get(a.ping.remote(), timeout=60) == "pong"
+    rt.kill(a)
+    remove_placement_group(pg)
+
+
+def test_collective_group(cluster):
+    @rt.remote
+    class Rank:
+        def init_group(self, world_size, rank, backend, name):
+            from ray_tpu.util import collective
+            collective.init_collective_group(world_size, rank, backend, name)
+            return True
+
+        def do_allreduce(self):
+            from ray_tpu.util import collective
+            return collective.allreduce(
+                np.ones(4) * (collective.get_rank("g1") + 1),
+                group_name="g1")
+
+        def do_broadcast(self):
+            from ray_tpu.util import collective
+            return collective.broadcast(
+                np.arange(3) if collective.get_rank("g1") == 0 else
+                np.zeros(3), src_rank=0, group_name="g1")
+
+    actors = [Rank.remote() for _ in range(3)]
+    from ray_tpu.util.collective import create_collective_group
+    create_collective_group(actors, 3, [0, 1, 2], group_name="g1")
+    outs = rt.get([a.do_allreduce.remote() for a in actors], timeout=60)
+    for o in outs:
+        np.testing.assert_allclose(o, np.ones(4) * 6)  # 1+2+3
+    outs = rt.get([a.do_broadcast.remote() for a in actors], timeout=60)
+    for o in outs:
+        np.testing.assert_allclose(o, np.arange(3))
+    for a in actors:
+        rt.kill(a)

@@ -1,0 +1,106 @@
+"""Tune tests: grid/random sweep, best-result selection, ASHA early
+stopping, trainer integration (parity: python/ray/tune/tests)."""
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
+
+
+def test_grid_search_best(cluster):
+    def objective(config):
+        return {"score": -(config["x"] - 3) ** 2}
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(grid) == 5
+    best = grid.get_best_result()
+    assert best.config["x"] == 3
+
+
+def test_random_search_space(cluster):
+    def objective(config):
+        return {"val": config["lr"]}
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(metric="val", mode="min", num_samples=6),
+    ).fit()
+    assert len(grid) == 6
+    for r in grid:
+        assert 1e-4 <= r.metrics["val"] <= 1e-1
+
+
+def test_reported_iterations_and_asha(cluster):
+    def trainable(config):
+        from ray_tpu.air import session
+        for i in range(20):
+            # good trials improve fast; bad ones plateau low
+            score = config["slope"] * (i + 1)
+            session.report({"score": score})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"slope": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.ASHAScheduler(metric="score", mode="max",
+                                         grace_period=2,
+                                         reduction_factor=2, max_t=20)),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.config["slope"] == 2.0
+
+
+def test_trial_error_isolated(cluster):
+    def objective(config):
+        if config["x"] == 1:
+            raise ValueError("boom")
+        return {"score": config["x"]}
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert grid.get_best_result().config["x"] == 2
+
+
+def test_tuner_over_trainer(cluster):
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    def loop(config):
+        from ray_tpu.air import session
+        session.report({"final": config["lr"] * 10})
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.1, 0.3])},
+        tune_config=tune.TuneConfig(metric="final", mode="max",
+                                    max_concurrent_trials=1,
+                                    resources_per_trial={"CPU": 1}),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["final"] == pytest.approx(3.0)
