@@ -260,6 +260,13 @@ class Conductor:
                     return {"nodes": [], "spilled": None}
                 self._cv.wait(min(remaining, 1.0))
 
+    def rpc_objects_exist(self, oids: List[bytes]) -> List[bool]:
+        """Batched readiness probe for dependency gating (the role of the
+        raylet's DependencyManager wait-before-dispatch)."""
+        with self._lock:
+            return [bool(self._object_locations.get(o)) or
+                    o in self._object_spilled for o in oids]
+
     def rpc_free_object(self, oid: bytes) -> None:
         with self._lock:
             nodes = [self._nodes[n]["address"]
@@ -388,15 +395,24 @@ class Conductor:
             self._drop_name(a)
             self._cv.notify_all()
 
-    def rpc_report_actor_death(self, actor_id: bytes, reason: str) -> None:
-        self._on_actor_death(actor_id, reason)
+    def rpc_report_actor_death(self, actor_id: bytes, reason: str,
+                               incarnation: Optional[int] = None) -> None:
+        self._on_actor_death(actor_id, reason, incarnation)
 
-    def _on_actor_death(self, actor_id: bytes, reason: str) -> None:
-        """Restart FSM (parity: gcs_actor_manager.h ALIVE->RESTARTING->...)."""
+    def _on_actor_death(self, actor_id: bytes, reason: str,
+                        incarnation: Optional[int] = None) -> None:
+        """Restart FSM (parity: gcs_actor_manager.h ALIVE->RESTARTING->...).
+
+        ``incarnation`` dedupes reports: one worker death can be observed
+        both by the daemon reaper and by a failed RPC — only the first
+        report for the current incarnation burns a restart.
+        """
         with self._cv:
             a = self._actors.get(actor_id)
             if a is None or a.state == DEAD:
                 return
+            if incarnation is not None and incarnation != a.incarnation:
+                return  # stale report about an already-replaced incarnation
             max_restarts = a.spec["opts"].get("max_restarts", 0)
             if max_restarts == -1 or a.num_restarts < max_restarts:
                 a.num_restarts += 1

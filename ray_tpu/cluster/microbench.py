@@ -1,0 +1,83 @@
+"""Core-runtime microbenchmark (parity: python/ray/_private/ray_perf.py:93
+`ray microbenchmark` — task/actor/object op throughput and latency)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _rate(n: int, seconds: float) -> str:
+    return f"{n / seconds:,.0f}/s"
+
+
+def run_microbenchmark(address: Optional[str] = None) -> dict:
+    import ray_tpu as rt
+    if address:
+        rt.init(address=address, ignore_reinit_error=True)
+    else:
+        rt.init(ignore_reinit_error=True)
+    results = {}
+
+    @rt.remote
+    def noop():
+        return None
+
+    @rt.remote
+    class Pinger:
+        def ping(self):
+            return None
+
+    # warm up the lease/worker path
+    rt.get([noop.remote() for _ in range(10)])
+
+    n = 300
+    t0 = time.perf_counter()
+    rt.get([noop.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    results["tasks_per_second"] = n / dt
+    print(f"tasks (batch submit+get): {_rate(n, dt)}")
+
+    t0 = time.perf_counter()
+    for _ in range(50):
+        rt.get(noop.remote())
+    dt = time.perf_counter() - t0
+    results["task_roundtrip_ms"] = dt / 50 * 1e3
+    print(f"single task round-trip: {dt / 50 * 1e3:.2f} ms")
+
+    actor = Pinger.remote()
+    rt.get(actor.ping.remote())
+    n = 500
+    t0 = time.perf_counter()
+    rt.get([actor.ping.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    results["actor_calls_per_second"] = n / dt
+    print(f"actor calls (pipelined): {_rate(n, dt)}")
+
+    t0 = time.perf_counter()
+    for _ in range(100):
+        rt.get(actor.ping.remote())
+    dt = time.perf_counter() - t0
+    results["actor_roundtrip_ms"] = dt / 100 * 1e3
+    print(f"single actor call round-trip: {dt / 100 * 1e3:.2f} ms")
+    rt.kill(actor)
+
+    for mb in (1, 64):
+        arr = np.random.rand(mb << 17)  # mb MB of float64
+        n = 20
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ref = rt.put(arr)
+            out = rt.get(ref)
+        dt = time.perf_counter() - t0
+        gbps = (arr.nbytes * n * 2) / dt / 1e9
+        results[f"put_get_{mb}mb_gbps"] = gbps
+        print(f"put+get {mb} MB: {gbps:.2f} GB/s round-trip")
+
+    return results
+
+
+if __name__ == "__main__":
+    run_microbenchmark()

@@ -44,6 +44,7 @@ class _Worker:
         self.actor_id: Optional[bytes] = None
         self.resources: Dict[str, float] = {}
         self.pg: Optional[Tuple[bytes, int]] = None
+        self.actor_incarnation: int = -1
 
 
 class NodeDaemon:
@@ -170,13 +171,28 @@ class NodeDaemon:
 
     def _checkout_worker(self, env_key: str, runtime_env: Optional[dict],
                          timeout: float = 30.0) -> Optional[_Worker]:
-        with self._lock:
-            q = self._idle.get(env_key)
-            while q:
-                token = q.popleft()
-                w = self._workers.get(token)
-                if w is not None and w.proc.poll() is None:
-                    return w
+        while True:
+            with self._lock:
+                q = self._idle.get(env_key)
+                w = None
+                while q:
+                    token = q.popleft()
+                    cand = self._workers.get(token)
+                    if cand is not None and cand.proc.poll() is None:
+                        w = cand
+                        break
+            if w is None:
+                break
+            # poll() can lag a dying process (a worker that just os._exit'd
+            # may not be reaped yet); a ping confirms the RPC server is
+            # actually accepting before we hand the lease out.
+            try:
+                get_client(w.address).call("ping", _timeout=2.0)
+                return w
+            except Exception:
+                from ray_tpu.cluster.protocol import drop_client
+                drop_client(w.address)
+                self._kill_worker(w)
         w = self._spawn_worker(env_key, runtime_env)
         if not w.registered.wait(timeout):
             try:
@@ -233,7 +249,8 @@ class NodeDaemon:
                     try:
                         get_client(self.conductor_address).call(
                             "report_actor_death", actor_id=w.actor_id,
-                            reason=f"worker process died (exit {exit_code})")
+                            reason=f"worker process died (exit {exit_code})",
+                            incarnation=w.actor_incarnation)
                     except Exception:
                         pass
 
@@ -389,6 +406,7 @@ class NodeDaemon:
             return
         with self._lock:
             w.actor_id = actor_id
+            w.actor_incarnation = incarnation
             w.resources = resources
             if isinstance(strategy, dict) and strategy.get("type") == "pg":
                 w.pg = (strategy["pg_id"], max(0, strategy.get("bundle_index", 0)))
@@ -399,9 +417,14 @@ class NodeDaemon:
         except Exception as e:
             self._release_actor_resources(w)
             self._kill_worker(w)
+            # Infrastructure failure (worker process died under us) — this
+            # consumes the restart FSM rather than permanently killing the
+            # actor; only a user __init__ exception is terminal.
             try:
-                cli.call("actor_creation_failed", actor_id=actor_id,
-                         incarnation=incarnation, error_blob=pickle.dumps(e))
+                cli.call("report_actor_death", actor_id=actor_id,
+                         reason=f"actor worker unreachable during "
+                                f"creation: {e}",
+                         incarnation=incarnation)
             except Exception:
                 pass
             return
@@ -485,7 +508,8 @@ class NodeDaemon:
                 try:
                     get_client(self.conductor_address).call(
                         "report_actor_death", actor_id=w.actor_id,
-                        reason="placement group removed")
+                        reason="placement group removed",
+                        incarnation=w.actor_incarnation)
                 except Exception:
                     pass
             self._kill_worker(w)

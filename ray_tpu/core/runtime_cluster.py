@@ -66,12 +66,13 @@ class _KeyState:
 
 
 class _TaskRecord:
-    __slots__ = ("task", "retries_left", "done")
+    __slots__ = ("task", "retries_left", "done", "cancelled")
 
     def __init__(self, task: dict, retries_left: int):
         self.task = task
         self.retries_left = retries_left
         self.done = False
+        self.cancelled = False
 
 
 class TaskSubmitter:
@@ -83,8 +84,23 @@ class TaskSubmitter:
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="submit")
+        # Lease acquisition runs on its own small pool: acquires can block
+        # ~1s each, and on the shared pool they starve task dispatches
+        # (observed: 83ms/task with 64 spinning acquirers).
+        self._lease_pool = ThreadPoolExecutor(max_workers=8,
+                                              thread_name_prefix="lease")
         # lineage: return-oid -> _TaskRecord for reconstruction
         self._lineage: Dict[bytes, _TaskRecord] = {}
+        # dependency gate (parity: raylet DependencyManager — a task only
+        # takes a worker lease once its ObjectRef args exist somewhere, so
+        # blocked consumers can never hold every worker while producers
+        # starve: the resource deadlock the reference avoids by pulling
+        # args before dispatch, dependency_manager.h)
+        self._waiting: List[_TaskRecord] = []
+        self._waiting_cv = threading.Condition()
+        self._dep_thread = threading.Thread(
+            target=self._dep_loop, daemon=True, name="dep-waiter")
+        self._dep_thread.start()
 
     def _key_state(self, key: tuple) -> _KeyState:
         with self._lock:
@@ -101,7 +117,53 @@ class TaskSubmitter:
             if len(self._lineage) > 20000:
                 # bounded lineage (parity: max_lineage_bytes budget)
                 self._lineage.pop(next(iter(self._lineage)))
-        self._enqueue(rec)
+        if task.get("deps"):
+            with self._waiting_cv:
+                self._waiting.append(rec)
+                self._waiting_cv.notify()
+        else:
+            self._enqueue(rec)
+
+    def _dep_loop(self) -> None:
+        """Sweep waiting tasks; release each once all its deps exist."""
+        idle_sleep = 0.01
+        while True:
+            with self._waiting_cv:
+                while not self._waiting:
+                    idle_sleep = 0.01
+                    self._waiting_cv.wait(1.0)
+                batch = [r for r in self._waiting if not r.cancelled]
+                if len(batch) != len(self._waiting):
+                    self._waiting = batch
+            ready: List[_TaskRecord] = []
+            try:
+                all_deps = sorted({d for rec in batch
+                                   for d in rec.task["deps"]})
+                exists = dict(zip(all_deps, self.rt.conductor.call(
+                    "objects_exist", oids=list(all_deps))))
+                for rec in batch:
+                    # deps are store keys (16B); check the directory, then
+                    # the local store (covers driver-local puts that raced
+                    # the async location registration).
+                    if all(exists.get(d) or
+                           self.rt.plane.store.contains(d)
+                           for d in rec.task["deps"]):
+                        ready.append(rec)
+            except Exception:
+                time.sleep(0.1)
+                continue
+            if ready:
+                idle_sleep = 0.01
+                with self._waiting_cv:
+                    self._waiting = [r for r in self._waiting
+                                     if r not in ready]
+                for rec in ready:
+                    self._enqueue(rec)
+            else:
+                # exponential backoff while nothing resolves: long stalls
+                # (slow producers) shouldn't hammer the conductor at 100 Hz
+                time.sleep(idle_sleep)
+                idle_sleep = min(idle_sleep * 2, 0.25)
 
     def _enqueue(self, rec: _TaskRecord) -> None:
         st = self._key_state(rec.task["key"])
@@ -113,6 +175,8 @@ class TaskSubmitter:
         """Dispatch queued tasks onto idle leases; grow the pool if short."""
         while True:
             with st.lock:
+                while st.queue and st.queue[0].cancelled:
+                    st.queue.popleft()
                 if not st.queue:
                     return
                 if st.idle:
@@ -122,11 +186,13 @@ class TaskSubmitter:
                 else:
                     need = len(st.queue)
                     have = st.busy + len(st.idle) + st.pending_leases
-                    if have < min(need + st.busy, _MAX_LEASES_PER_KEY):
+                    pending_cap = config.get("max_pending_lease_requests")
+                    if st.pending_leases < pending_cap and \
+                            have < min(need + st.busy, _MAX_LEASES_PER_KEY):
                         st.pending_leases += 1
                         rec0 = st.queue[0]
-                        self._pool.submit(self._acquire_lease, st,
-                                          dict(rec0.task))
+                        self._lease_pool.submit(self._acquire_lease, st,
+                                                dict(rec0.task))
                     return
             self._pool.submit(self._run_on, st, w, rec)
 
@@ -145,7 +211,7 @@ class TaskSubmitter:
                 time.sleep(0.2)
                 with st.lock:
                     st.pending_leases += 1
-                self._pool.submit(self._acquire_lease, st, task)
+                self._lease_pool.submit(self._acquire_lease, st, task)
             return
         with st.lock:
             st.idle.append(w)
@@ -166,12 +232,18 @@ class TaskSubmitter:
             rec.done = True
         except (ConnectionLost, OSError, RpcError):
             w.alive = False
+            from ray_tpu.cluster.protocol import drop_client
+            drop_client(w.address)  # pooled sockets are stale now
             self.rt._drop_lease(w)
             with st.lock:
                 st.busy -= 1
             if rec.retries_left != 0:
                 if rec.retries_left > 0:
                     rec.retries_left -= 1
+                # brief backoff so the daemon's reaper notices the dead
+                # worker before the retry re-leases (avoids burning every
+                # retry on the same dying process)
+                time.sleep(0.25)
                 self._enqueue(rec)
             else:
                 err = TaskError.from_exception(
@@ -604,6 +676,14 @@ class ClusterRuntime:
         self._register_function(desc, blob)
         task_id = TaskID.from_random()
         args_blob = serialization.dumps((list(args), dict(kwargs)))
+        # Dependency gate covers exactly what the worker will inline:
+        # TOP-LEVEL ObjectRef args (_resolve in worker_main.py). Refs nested
+        # inside containers are passed through as refs (Ray semantics) and
+        # must NOT block dispatch — a monitor handed a list of in-progress
+        # refs has to start immediately.
+        deps = [self.plane._key(a.id)
+                for a in list(args) + list(kwargs.values())
+                if isinstance(a, ObjectRef)]
         resources = {"CPU": opts.num_cpus, "TPU": opts.num_tpus,
                      **opts.resources}
         resources = {k: v for k, v in resources.items() if v > 0}
@@ -621,6 +701,7 @@ class ClusterRuntime:
             "runtime_env": opts.runtime_env,
             "name": opts.name or desc.repr_name(),
             "max_retries": max_retries,
+            "deps": deps,
             "key": (desc.function_id, tuple(sorted(resources.items())),
                     repr(strategy), repr(opts.runtime_env)),
         }
@@ -727,10 +808,24 @@ class ClusterRuntime:
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         rec = self.submitter._lineage.get(ref.id.binary())
-        if rec is not None and not rec.done:
-            self._store_error_returns(
-                rec.task, TaskError.from_exception(
-                    TaskCancelledError("task cancelled"), rec.task["name"]))
+        if rec is None or rec.done:
+            return
+        rec.cancelled = True  # dropped from queues by _pump/_dep_loop
+        # Best effort for an already-dispatched task: tell every leased
+        # worker of this key to skip it if it hasn't started yet.
+        st = self.submitter._keys.get(rec.task.get("key"))
+        if st is not None:
+            with st.lock:
+                workers = list(st.idle)
+            for w in workers:
+                try:
+                    get_client(w.address).call("cancel_task",
+                                               task_id=rec.task["task_id"])
+                except Exception:
+                    pass
+        self._store_error_returns(
+            rec.task, TaskError.from_exception(
+                TaskCancelledError("task cancelled"), rec.task["name"]))
 
     # ------------------------------------------------------------------
     # placement groups (public surface lives in util/placement_group.py)

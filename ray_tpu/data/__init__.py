@@ -1,0 +1,19 @@
+"""ray_tpu.data — distributed block-based data library.
+
+Parity surface: reference python/ray/data (Dataset dataset.py:168, blocks
+block.py:237, lazy plan _internal/plan.py, streaming executor
+streaming_executor.py:45, shuffle push_based_shuffle.py, datasources
+datasource/, DatasetPipeline dataset_pipeline.py).
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import Dataset, DatasetPipeline, GroupedData
+from ray_tpu.data.datasource import (from_arrow, from_items, from_numpy,
+                                     from_pandas, range, read_binary_files,
+                                     read_csv, read_json, read_numpy,
+                                     read_parquet)
+
+__all__ = ["Dataset", "DatasetPipeline", "GroupedData", "Block",
+           "BlockAccessor", "range", "from_items", "from_numpy",
+           "from_pandas", "from_arrow", "read_parquet", "read_csv",
+           "read_json", "read_numpy", "read_binary_files"]

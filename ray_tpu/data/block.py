@@ -1,0 +1,163 @@
+"""Blocks: the distributed unit of a Dataset.
+
+Role parity: python/ray/data/block.py:237 (BlockAccessor) with the Arrow and
+pandas block implementations (_internal/arrow_block.py, pandas_block.py).
+A block is a pyarrow.Table (the canonical format — zero-copy through the
+shm object plane via Arrow buffers), with converters from/to rows, numpy
+dicts, and pandas.
+
+TPU-first note: ``to_numpy_batch`` produces contiguous host arrays sized
+for device_put — the feed format for per-host input pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+
+Block = pa.Table
+
+
+def block_from_rows(rows: List[Any]) -> Block:
+    """Rows: dicts -> columns; scalars -> single 'item' column."""
+    if rows and isinstance(rows[0], dict):
+        cols: Dict[str, list] = {}
+        for r in rows:
+            for k in r:
+                cols.setdefault(k, [])
+        for r in rows:
+            for k in cols:
+                cols[k].append(r.get(k))
+        return pa.table({k: pa.array(v) for k, v in cols.items()})
+    return pa.table({"item": pa.array(rows)})
+
+
+def block_from_numpy(arrays: Dict[str, np.ndarray]) -> Block:
+    out = {}
+    for k, v in arrays.items():
+        v = np.asarray(v)
+        if v.ndim <= 1:
+            out[k] = pa.array(v)
+        else:
+            # tensor column: fixed-size-list encoding, shape in metadata
+            flat = v.reshape(len(v), -1)
+            out[k] = pa.FixedSizeListArray.from_arrays(
+                pa.array(flat.ravel()), flat.shape[1])
+            # shape restored in to_numpy via _tensor_shapes metadata
+    t = pa.table(out)
+    shapes = {k: np.asarray(v).shape[1:] for k, v in arrays.items()
+              if np.asarray(v).ndim > 1}
+    if shapes:
+        import json
+        meta = {b"_tensor_shapes": json.dumps(
+            {k: list(s) for k, s in shapes.items()}).encode()}
+        t = t.replace_schema_metadata(meta)
+    return t
+
+
+def block_from_pandas(df) -> Block:
+    return pa.Table.from_pandas(df, preserve_index=False)
+
+
+class BlockAccessor:
+    """Uniform view over a block (parity: block.py:237)."""
+
+    def __init__(self, block: Block):
+        if not isinstance(block, pa.Table):
+            raise TypeError(f"block must be a pyarrow.Table, got {type(block)}")
+        self.block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    def _tensor_shapes(self) -> Dict[str, tuple]:
+        meta = self.block.schema.metadata or {}
+        raw = meta.get(b"_tensor_shapes")
+        if not raw:
+            return {}
+        import json
+        return {k: tuple(v) for k, v in json.loads(raw.decode()).items()}
+
+    def to_numpy(self, columns: Optional[List[str]] = None
+                 ) -> Dict[str, np.ndarray]:
+        cols = columns or self.block.column_names
+        shapes = self._tensor_shapes()
+        out = {}
+        for name in cols:
+            col = self.block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                flat = col.combine_chunks().flatten().to_numpy(
+                    zero_copy_only=False)
+                n = self.block.num_rows
+                shape = shapes.get(name)
+                out[name] = flat.reshape((n, -1) if shape is None
+                                         else (n, *shape))
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        return self.block.to_pandas()
+
+    def to_rows(self) -> List[dict]:
+        return self.block.to_pylist()
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    def take_indices(self, indices: np.ndarray) -> Block:
+        return self.block.take(pa.array(indices))
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+        metas = [b.schema.metadata for b in blocks if b.schema.metadata]
+        out = pa.concat_tables(
+            [b.replace_schema_metadata(None) for b in blocks],
+            promote_options="default")
+        if metas:
+            out = out.replace_schema_metadata(metas[0])
+        return out
+
+
+def normalize_batch_to_block(batch: Any) -> Block:
+    """Map/It outputs -> block: Table | dict-of-arrays | pandas | rows."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        return block_from_numpy(batch)
+    try:
+        import pandas as pd
+        if isinstance(batch, pd.DataFrame):
+            return block_from_pandas(batch)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return block_from_rows(batch)
+    raise TypeError(f"cannot convert {type(batch)} to a Block")
+
+
+def format_batch(block: Block, batch_format: str):
+    acc = BlockAccessor(block)
+    if batch_format in ("numpy", "default"):
+        return acc.to_numpy()
+    if batch_format == "pandas":
+        return acc.to_pandas()
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    if batch_format == "rows":
+        return acc.to_rows()
+    raise ValueError(f"unknown batch_format {batch_format!r}")

@@ -1,0 +1,482 @@
+"""Dataset: lazy, distributed, block-based data processing.
+
+Role parity: python/ray/data/dataset.py:168 (Dataset over distributed
+Blocks), _internal/plan.py (lazy logical plan), streaming_executor.py:45
+(pipelined execution with bounded in-flight), push_based_shuffle.py
+(map/reduce shuffle; here a hash/round-robin two-stage shuffle over tasks).
+
+Blocks are pyarrow Tables living in the shm object store as ObjectRefs;
+transforms are tasks (one per block) submitted through the normal lease
+path, so data processing shares the scheduler with everything else.
+
+TPU-first: ``iter_batches`` is the per-host input pipeline — it streams
+block refs with a bounded prefetch window and yields contiguous numpy
+batches ready for device_put (double-buffering host->HBM happens in
+train/input_pipeline.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import (Block, BlockAccessor, block_from_rows,
+                                format_batch, normalize_batch_to_block)
+
+_MAX_INFLIGHT = 16   # streaming executor: concurrent transform tasks
+
+_remote_cache: Dict[Any, Any] = {}
+
+
+def _remote_for(task_fn, **opts):
+    """One RemoteFunction per transform fn, so the function blob is pickled
+    and registered once per driver (hot path for per-block tasks)."""
+    key = (task_fn, tuple(sorted(opts.items())))
+    rf = _remote_cache.get(key)
+    if rf is None:
+        import ray_tpu as rt
+        rf = rt.remote(task_fn).options(num_cpus=1, **opts)
+        _remote_cache[key] = rf
+    return rf
+
+
+# ---------------------------------------------------------------------------
+# transform tasks (module-level so workers unpickle them once by function id)
+# ---------------------------------------------------------------------------
+
+def _map_batches_task(block: Block, fn_blob: bytes, batch_size: Optional[int],
+                      batch_format: str) -> Block:
+    import cloudpickle
+    fn = cloudpickle.loads(fn_blob)
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    outs: List[Block] = []
+    step = batch_size or max(1, n)
+    for start in range(0, max(n, 1), step):
+        sub = acc.slice(start, min(start + step, n)) if n else block
+        out = fn(format_batch(sub, batch_format))
+        outs.append(normalize_batch_to_block(out))
+        if n == 0:
+            break
+    return BlockAccessor.concat(outs) if outs else block
+
+
+def _map_rows_task(block: Block, fn_blob: bytes, flat: bool) -> Block:
+    import cloudpickle
+    fn = cloudpickle.loads(fn_blob)
+    rows_out: List[Any] = []
+    for row in BlockAccessor(block).to_rows():
+        r = fn(row)
+        if flat:
+            rows_out.extend(r)
+        else:
+            rows_out.append(r)
+    return block_from_rows(rows_out)
+
+
+def _filter_task(block: Block, fn_blob: bytes) -> Block:
+    import cloudpickle
+    fn = cloudpickle.loads(fn_blob)
+    acc = BlockAccessor(block)
+    keep = np.array([bool(fn(r)) for r in acc.to_rows()], dtype=bool)
+    return acc.take_indices(np.nonzero(keep)[0])
+
+
+def _split_task(block: Block, n_out: int, seed: Optional[int],
+                index: int) -> List[Block]:
+    """Shuffle map stage: partition one block into n_out shards."""
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if seed is None:
+        idx = np.arange(n)
+    else:
+        rng = np.random.default_rng((seed, index))
+        idx = rng.permutation(n)
+    shards = np.array_split(idx, n_out)
+    return [acc.take_indices(s) for s in shards]
+
+
+def _merge_task(*blocks: Block) -> Block:
+    return BlockAccessor.concat(list(blocks))
+
+
+def _merge_shuffle_task(seed, index, *blocks: Block) -> Block:
+    merged = BlockAccessor.concat(list(blocks))
+    if seed is None:
+        return merged
+    acc = BlockAccessor(merged)
+    rng = np.random.default_rng((seed, index, 1))
+    return acc.take_indices(rng.permutation(acc.num_rows()))
+
+
+def _sort_block_task(block: Block, key: str, descending: bool) -> Block:
+    import pyarrow.compute as pc
+    order = "descending" if descending else "ascending"
+    idx = pc.sort_indices(block, sort_keys=[(key, order)])
+    return block.take(idx)
+
+
+def _groupby_partition_task(block: Block, key: str, n_out: int) -> List[Block]:
+    acc = BlockAccessor(block)
+    keys = acc.to_numpy([key])[key]
+    hashes = np.array([hash(k) % n_out for k in keys])
+    return [acc.take_indices(np.nonzero(hashes == i)[0])
+            for i in range(n_out)]
+
+
+def _groupby_agg_task(key: str, aggs: List[tuple], *blocks: Block) -> Block:
+    import pyarrow as pa
+    merged = BlockAccessor.concat(list(blocks))
+    if merged.num_rows == 0:
+        return merged
+    tbl = merged.group_by(key).aggregate(aggs)
+    return tbl
+
+
+# ---------------------------------------------------------------------------
+# logical plan
+# ---------------------------------------------------------------------------
+
+class _Op:
+    """One stage: turns an iterator of block refs into another."""
+
+    def apply(self, refs_iter: Iterator, submit) -> Iterator:
+        raise NotImplementedError
+
+
+class _OneToOneOp(_Op):
+    """Per-block task stage — streams with bounded in-flight."""
+
+    def __init__(self, task_fn, *args):
+        self.task_fn = task_fn
+        self.args = args
+
+    def apply(self, refs_iter, submit):
+        import ray_tpu as rt
+        from collections import deque
+        inflight: deque = deque()
+        for ref in refs_iter:
+            inflight.append(submit(self.task_fn, ref, *self.args))
+            while len(inflight) >= _MAX_INFLIGHT:
+                yield inflight.popleft()
+        while inflight:
+            yield inflight.popleft()
+
+
+class _AllToAllOp(_Op):
+    """Barrier stage (shuffle/repartition/sort): needs all input refs."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def apply(self, refs_iter, submit):
+        refs = list(refs_iter)
+        return iter(self.fn(refs, submit))
+
+
+class _LimitOp(_Op):
+    def __init__(self, n: int):
+        self.n = n
+
+    def apply(self, refs_iter, submit):
+        import ray_tpu as rt
+        remaining = self.n
+        for ref in refs_iter:
+            if remaining <= 0:
+                return
+            block = rt.get(ref)
+            acc = BlockAccessor(block)
+            if acc.num_rows() <= remaining:
+                remaining -= acc.num_rows()
+                yield ref
+            else:
+                yield rt.put(acc.slice(0, remaining))
+                remaining = 0
+
+
+# ---------------------------------------------------------------------------
+# Dataset
+# ---------------------------------------------------------------------------
+
+class Dataset:
+    def __init__(self, source_refs: List[Any], ops: Optional[List[_Op]] = None):
+        self._source_refs = source_refs
+        self._ops = ops or []
+        self._materialized: Optional[List[Any]] = None
+
+    # -- plan building ---------------------------------------------------
+    def _with_op(self, op: _Op) -> "Dataset":
+        return Dataset(self._source_refs, self._ops + [op])
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy") -> "Dataset":
+        import cloudpickle
+        return self._with_op(_OneToOneOp(
+            _map_batches_task, cloudpickle.dumps(fn), batch_size,
+            batch_format))
+
+    def map(self, fn: Callable) -> "Dataset":
+        import cloudpickle
+        return self._with_op(_OneToOneOp(_map_rows_task,
+                                         cloudpickle.dumps(fn), False))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        import cloudpickle
+        return self._with_op(_OneToOneOp(_map_rows_task,
+                                         cloudpickle.dumps(fn), True))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        import cloudpickle
+        return self._with_op(_OneToOneOp(_filter_task, cloudpickle.dumps(fn)))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(_LimitOp(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_op(_AllToAllOp(
+            lambda refs, submit: _shuffle(refs, submit, num_blocks, None)))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        seed = seed if seed is not None else np.random.randint(1 << 31)
+        return self._with_op(_AllToAllOp(
+            lambda refs, submit: _shuffle(refs, submit,
+                                          max(1, len(refs)), seed)))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def do_sort(refs, submit):
+            # per-block sort then a single merge task (K-way merge would be
+            # the scaled version; blocks are modest here)
+            sorted_refs = [submit(_sort_block_task, r, key, descending)
+                           for r in refs]
+            merged = submit(_merge_task, *sorted_refs)
+            return [submit(_sort_block_task, merged, key, descending)]
+        return self._with_op(_AllToAllOp(do_sort))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(self.materialize_refs() + other.materialize_refs())
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -- execution -------------------------------------------------------
+    def _submit(self, task_fn, *args):
+        return _remote_for(task_fn).remote(*args)
+
+    def iter_block_refs(self) -> Iterator:
+        """Streaming execution: block refs flow through op stages with
+        bounded in-flight (parity: streaming_executor.py:45)."""
+        if self._materialized is not None:
+            return iter(self._materialized)
+        it: Iterator = iter(self._source_refs)
+        for op in self._ops:
+            it = op.apply(it, self._submit)
+        return it
+
+    def materialize_refs(self) -> List[Any]:
+        if self._materialized is None:
+            self._materialized = list(self.iter_block_refs())
+            self._source_refs = self._materialized
+            self._ops = []
+        return self._materialized
+
+    def materialize(self) -> "Dataset":
+        self.materialize_refs()
+        return self
+
+    # -- consumption -----------------------------------------------------
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     prefetch_blocks: int = 2,
+                     drop_last: bool = False) -> Iterator[Any]:
+        import ray_tpu as rt
+        from collections import deque
+        refs = self.iter_block_refs()
+        window: deque = deque()
+        carry: Optional[Block] = None
+
+        def fill():
+            while len(window) < prefetch_blocks + 1:
+                try:
+                    window.append(next(refs))
+                except StopIteration:
+                    return False
+            return True
+
+        exhausted = False
+        while True:
+            if not exhausted:
+                exhausted = not fill()
+            have = (BlockAccessor(carry).num_rows() if carry is not None
+                    else 0)
+            while window and have < batch_size:
+                block = rt.get(window.popleft())
+                carry = block if carry is None else \
+                    BlockAccessor.concat([carry, block])
+                have = BlockAccessor(carry).num_rows()
+                if not exhausted:
+                    exhausted = not fill()
+            if carry is None or have == 0:
+                return
+            acc = BlockAccessor(carry)
+            if have >= batch_size:
+                yield format_batch(acc.slice(0, batch_size), batch_format)
+                carry = acc.slice(batch_size, have) if have > batch_size \
+                    else None
+            elif not window:
+                if not drop_last:
+                    yield format_batch(carry, batch_format)
+                return
+
+    def iter_rows(self) -> Iterator[dict]:
+        import ray_tpu as rt
+        for ref in self.iter_block_refs():
+            yield from BlockAccessor(rt.get(ref)).to_rows()
+
+    def take(self, n: int = 20) -> List[dict]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[dict]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        import ray_tpu as rt
+        return sum(BlockAccessor(rt.get(r)).num_rows()
+                   for r in self.materialize_refs())
+
+    def schema(self):
+        import ray_tpu as rt
+        refs = self.materialize_refs()
+        if not refs:
+            return None
+        return BlockAccessor(rt.get(refs[0])).schema()
+
+    def num_blocks(self) -> int:
+        return len(self.materialize_refs())
+
+    def size_bytes(self) -> int:
+        import ray_tpu as rt
+        return sum(BlockAccessor(rt.get(r)).size_bytes()
+                   for r in self.materialize_refs())
+
+    def to_pandas(self):
+        import pandas as pd
+        import ray_tpu as rt
+        return BlockAccessor.concat(
+            [rt.get(r) for r in self.materialize_refs()]).to_pandas()
+
+    def split(self, n: int) -> List["Dataset"]:
+        refs = self.materialize_refs()
+        parts = np.array_split(np.arange(len(refs)), n)
+        return [Dataset([refs[i] for i in idx]) for idx in parts]
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        return DatasetPipeline(self, times)
+
+    def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
+        return DatasetPipeline(self, 1, blocks_per_window)
+
+    # -- writes ----------------------------------------------------------
+    def write_parquet(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_blocks
+        write_blocks(self, path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_blocks
+        write_blocks(self, path, "csv")
+
+    def write_json(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_blocks
+        write_blocks(self, path, "json")
+
+    def __repr__(self):
+        return (f"Dataset(num_source_blocks={len(self._source_refs)}, "
+                f"pending_ops={len(self._ops)})")
+
+
+def _shuffle(refs: List[Any], submit, num_out: int,
+             seed: Optional[int]) -> List[Any]:
+    """Two-stage shuffle (parity: push_based_shuffle.py map/merge):
+    stage 1 splits each block into num_out shards; stage 2 merges shard i
+    of every block (+ local permutation when seeded)."""
+    import ray_tpu as rt
+    if not refs:
+        return refs
+    shard_refs = []
+    for i, r in enumerate(refs):
+        out = _remote_for(_split_task, num_returns=num_out).remote(
+            r, num_out, seed, i)
+        shard_refs.append(out if isinstance(out, list) else [out])
+    merged = []
+    for j in range(num_out):
+        cols = [shard_refs[i][j] for i in range(len(refs))]
+        merged.append(submit(_merge_shuffle_task, seed, j, *cols))
+    return merged
+
+
+class GroupedData:
+    """Hash-partitioned groupby aggregations (parity:
+    grouped_data.py over arrow group_by)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self.ds = ds
+        self.key = key
+
+    def _aggregate(self, aggs: List[tuple]) -> Dataset:
+        import ray_tpu as rt
+        refs = self.ds.materialize_refs()
+        n_parts = max(1, min(len(refs), 8))
+        part_refs = []
+        for r in refs:
+            out = _remote_for(_groupby_partition_task,
+                              num_returns=n_parts).remote(r, self.key, n_parts)
+            part_refs.append(out if isinstance(out, list) else [out])
+        agg_refs = []
+        for j in range(n_parts):
+            cols = [part_refs[i][j] for i in range(len(refs))]
+            agg_refs.append(_remote_for(_groupby_agg_task).remote(
+                self.key, aggs, *cols))
+        return Dataset(agg_refs)
+
+    def count(self) -> Dataset:
+        return self._aggregate([(self.key, "count")])
+
+    def sum(self, col: str) -> Dataset:
+        return self._aggregate([(col, "sum")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._aggregate([(col, "mean")])
+
+    def min(self, col: str) -> Dataset:
+        return self._aggregate([(col, "min")])
+
+    def max(self, col: str) -> Dataset:
+        return self._aggregate([(col, "max")])
+
+
+class DatasetPipeline:
+    """Windowed/repeated pipelining (parity: dataset_pipeline.py)."""
+
+    def __init__(self, ds: Dataset, times: Optional[int] = None,
+                 blocks_per_window: Optional[int] = None):
+        self.ds = ds
+        self.times = times
+        self.blocks_per_window = blocks_per_window
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        epoch = 0
+        while self.times is None or epoch < self.times:
+            yield from self.ds.iter_batches(**kwargs)
+            epoch += 1
+
+    def iter_epochs(self) -> Iterator[Dataset]:
+        epoch = 0
+        while self.times is None or epoch < self.times:
+            yield self.ds
+            epoch += 1

@@ -1,0 +1,157 @@
+"""Datasources: readers/writers for files and in-memory data.
+
+Role parity: python/ray/data/datasource/ + read_api.py — range, from_items,
+from_numpy, from_pandas/arrow, read_parquet/csv/json/numpy/binary_files,
+write_parquet/csv/json. File reads fan out one task per file (the
+reference's read-task model) so IO parallelizes across the cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import (block_from_numpy, block_from_pandas,
+                                block_from_rows)
+from ray_tpu.data.dataset import Dataset
+
+
+def _put_blocks(blocks) -> Dataset:
+    import ray_tpu as rt
+    return Dataset([rt.put(b) for b in blocks])
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    edges = np.linspace(0, n, min(parallelism, max(1, n)) + 1, dtype=np.int64)
+    blocks = [block_from_numpy({"id": np.arange(a, b)})
+              for a, b in zip(edges[:-1], edges[1:]) if b > a]
+    return _put_blocks(blocks)
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    if not items:
+        return Dataset([])
+    chunks = np.array_split(np.arange(len(items)),
+                            min(parallelism, len(items)))
+    blocks = [block_from_rows([items[i] for i in c]) for c in chunks if len(c)]
+    return _put_blocks(blocks)
+
+
+def from_numpy(arrays, *, column: str = "data") -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    return _put_blocks([block_from_numpy({column: a}) for a in arrays])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return _put_blocks([block_from_pandas(df) for df in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _put_blocks(tables)
+
+
+def _expand_paths(path, suffix: Optional[str] = None) -> List[str]:
+    paths = path if isinstance(path, list) else [path]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if suffix is None or name.endswith(suffix):
+                    out.append(os.path.join(p, name))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files under {path}")
+    return out
+
+
+def _read_parquet_file(path: str):
+    import pyarrow.parquet as pq
+    return pq.read_table(path)
+
+
+def _read_csv_file(path: str):
+    import pyarrow.csv as pcsv
+    return pcsv.read_csv(path)
+
+
+def _read_json_file(path: str):
+    import pyarrow.json as pjson
+    return pjson.read_json(path)
+
+
+def _read_numpy_file(path: str):
+    return block_from_numpy({"data": np.load(path)})
+
+
+def _read_binary_file(path: str):
+    with open(path, "rb") as f:
+        return block_from_rows([{"path": path, "bytes": f.read()}])
+
+
+_READERS = {
+    "parquet": (_read_parquet_file, ".parquet"),
+    "csv": (_read_csv_file, ".csv"),
+    "json": (_read_json_file, ".json"),
+    "numpy": (_read_numpy_file, ".npy"),
+    "binary": (_read_binary_file, None),
+}
+
+
+def _read_files(path, kind: str) -> Dataset:
+    import ray_tpu as rt
+    reader, suffix = _READERS[kind]
+    files = _expand_paths(path, suffix)
+    remote = rt.remote(reader).options(num_cpus=1)
+    return Dataset([remote.remote(f) for f in files])
+
+
+def read_parquet(path) -> Dataset:
+    return _read_files(path, "parquet")
+
+
+def read_csv(path) -> Dataset:
+    return _read_files(path, "csv")
+
+
+def read_json(path) -> Dataset:
+    return _read_files(path, "json")
+
+
+def read_numpy(path) -> Dataset:
+    return _read_files(path, "numpy")
+
+
+def read_binary_files(path) -> Dataset:
+    return _read_files(path, "binary")
+
+
+def _write_block(block, path: str, fmt: str, index: int) -> str:
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.{fmt}")
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(block, out)
+    elif fmt == "csv":
+        import pyarrow.csv as pcsv
+        pcsv.write_csv(block, out)
+    elif fmt == "json":
+        block.to_pandas().to_json(out, orient="records", lines=True)
+    else:
+        raise ValueError(fmt)
+    return out
+
+
+def write_blocks(ds: Dataset, path: str, fmt: str) -> List[str]:
+    import ray_tpu as rt
+    remote = rt.remote(_write_block).options(num_cpus=1)
+    refs = [remote.remote(r, path, fmt, i)
+            for i, r in enumerate(ds.materialize_refs())]
+    return rt.get(refs)

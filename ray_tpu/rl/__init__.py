@@ -1,0 +1,25 @@
+"""ray_tpu.rl — reinforcement-learning library (RLlib-equivalent core).
+
+Parity surface: reference rllib/ — Algorithm/AlgorithmConfig
+(algorithms/algorithm.py:149, algorithm_config.py:117), RolloutWorker +
+WorkerSet (evaluation/), RLModule/Learner/LearnerGroup (core/), SampleBatch
+(policy/sample_batch.py:96), vector/multi-agent envs (env/), replay buffers
+(utils/replay_buffers). TPU-first: policies are pure-jax modules, rollout
+forwards are one jitted batched call per vector-env step, and the learner
+update is a single pjit-able function (DP gradient psum compiled by XLA).
+"""
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rl.env import (CartPoleVectorEnv, GymVectorEnv, MultiAgentEnv,
+                            VectorEnv, make_env)
+from ray_tpu.rl.learner import LearnerGroup, PPOLearner
+from ray_tpu.rl.module import RLModule
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rl.rollout import RolloutWorker, compute_gae
+from ray_tpu.rl.sample_batch import SampleBatch
+
+__all__ = ["Algorithm", "AlgorithmConfig", "WorkerSet", "VectorEnv",
+           "CartPoleVectorEnv", "GymVectorEnv", "MultiAgentEnv", "make_env",
+           "RLModule", "RolloutWorker", "compute_gae", "SampleBatch",
+           "PPOLearner", "LearnerGroup", "ReplayBuffer",
+           "PrioritizedReplayBuffer"]

@@ -1,0 +1,191 @@
+"""Algorithm base + config: the RL training driver.
+
+Role parity: rllib/algorithms/algorithm.py:149 (Algorithm(Trainable):
+setup builds the WorkerSet, train() -> training_step) and
+algorithm_config.py:117 (AlgorithmConfig fluent builder). The WorkerSet
+(evaluation/worker_set.py:79) is a list of RolloutWorker actors with
+fault-tolerant foreach (probe_unhealthy_workers role) and object-store
+weight broadcast (sync_weights:384).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.rollout import RolloutWorker
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class AlgorithmConfig:
+    """Fluent config (parity: algorithm_config.py:117)."""
+
+    def __init__(self):
+        self.env: Any = "CartPole-v1"
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 8
+        self.rollout_fragment_length = 64
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.lr = 3e-4
+        self.train_batch_size = 1024
+        self.model_hiddens = (64, 64)
+        self.seed = 0
+        self.learner_remote = False
+        self.learner_num_tpus = 0.0
+        self.extra: Dict[str, Any] = {}
+
+    def environment(self, env=None, **kwargs) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        self.extra.update(kwargs)
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None
+                 ) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def resources(self, *, learner_remote: Optional[bool] = None,
+                  learner_num_tpus: Optional[float] = None
+                  ) -> "AlgorithmConfig":
+        if learner_remote is not None:
+            self.learner_remote = learner_remote
+        if learner_num_tpus is not None:
+            self.learner_num_tpus = learner_num_tpus
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)  # type: ignore[attr-defined]
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+
+class WorkerSet:
+    """Rollout-worker actors (parity: worker_set.py:79)."""
+
+    def __init__(self, config: AlgorithmConfig, module_spec: dict):
+        import ray_tpu as rt
+        cls = rt.remote(RolloutWorker)
+        self.workers = [
+            cls.options(num_cpus=1).remote(
+                config.env, module_spec, config.rollout_fragment_length,
+                config.num_envs_per_worker, config.gamma, config.lambda_,
+                seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_rollout_workers)
+        ]
+
+    def sample(self, weights_ref) -> List[SampleBatch]:
+        import ray_tpu as rt
+        return rt.get([w.sample.remote(weights_ref) for w in self.workers],
+                      timeout=600)
+
+    def sync_weights(self, weights) -> Any:
+        """Broadcast via one object-store put (parity: sync_weights:384)."""
+        import ray_tpu as rt
+        return rt.put(weights)
+
+    def episode_stats(self) -> List[dict]:
+        import ray_tpu as rt
+        return rt.get([w.episode_stats.remote() for w in self.workers],
+                      timeout=600)
+
+    def stop(self) -> None:
+        import ray_tpu as rt
+        for w in self.workers:
+            try:
+                rt.kill(w)
+            except Exception:
+                pass
+
+
+class Algorithm:
+    """Trainable-style driver: .train() one iteration at a time."""
+
+    _default_config: Callable[[], AlgorithmConfig]
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        probe = make_env(config.env, num_envs=1, seed=config.seed)
+        self.module_spec = {
+            "obs_dim": probe.observation_dim,
+            "num_actions": probe.num_actions,
+            "hiddens": tuple(config.model_hiddens),
+        }
+        self.setup()
+
+    def setup(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        start = time.time()
+        result = self.training_step()
+        self.iteration += 1
+        result.update({
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "time_this_iter_s": time.time() - start,
+        })
+        return result
+
+    # -- checkpointing (parity: Trainable.save/restore) ------------------
+    def get_state(self) -> dict:
+        raise NotImplementedError
+
+    def set_state(self, state: dict) -> None:
+        raise NotImplementedError
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        checkpoint_dir = checkpoint_dir or tempfile.mkdtemp(
+            prefix="rtpu-algo-")
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({"iteration": self.iteration,
+                         "timesteps_total": self._timesteps_total,
+                         "state": self.get_state()}, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            blob = pickle.load(f)
+        self.iteration = blob["iteration"]
+        self._timesteps_total = blob["timesteps_total"]
+        self.set_state(blob["state"])
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,176 @@
+"""DQN (parity: rllib/algorithms/dqn — replay buffer + target network +
+double-Q update; epsilon-greedy exploration on vectorized envs)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.module import RLModule, mlp_apply, mlp_init
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.buffer_capacity = 50_000
+        self.learning_starts = 1000
+        self.target_update_freq = 500   # env steps between target syncs
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 10_000
+        self.train_batch_size = 32
+        self.updates_per_iter = 64
+        self.gamma = 0.99
+        self.lr = 5e-4
+        self.algo_class = DQN
+
+
+class DQNCollector:
+    """Actor: epsilon-greedy stepping of a vector env, emitting
+    (s, a, r, s', done) transitions."""
+
+    def __init__(self, env: Any, module_spec: dict, num_envs: int,
+                 seed: int = 0):
+        import jax
+        self.env = make_env(env, num_envs=num_envs, seed=seed)
+        self.module = RLModule(**module_spec)
+        self.obs = self.env.vector_reset(seed=seed)
+        self._rng = np.random.default_rng(seed)
+        self._q_fn = jax.jit(lambda p, o: self.module.apply(p, o)[0])
+        self.params = None
+
+    def collect(self, params, steps: int, epsilon: float) -> SampleBatch:
+        self.params = params
+        N = self.env.num_envs
+        rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS,
+                                sb.DONES)}
+        for _ in range(steps):
+            q = np.asarray(self._q_fn(self.params, self.obs))
+            greedy = q.argmax(axis=1)
+            explore = self._rng.random(N) < epsilon
+            random_a = self._rng.integers(0, q.shape[1], N)
+            actions = np.where(explore, random_a, greedy)
+            next_obs, rew, done, _ = self.env.vector_step(actions)
+            rows[sb.OBS].append(self.obs.copy())
+            rows[sb.ACTIONS].append(actions)
+            rows[sb.REWARDS].append(rew)
+            rows[sb.NEXT_OBS].append(next_obs.copy())
+            rows[sb.DONES].append(done)
+            self.obs = next_obs
+        return SampleBatch({k: np.concatenate(v) for k, v in rows.items()})
+
+    def episode_stats(self) -> dict:
+        rets = getattr(self.env, "completed_returns", [])
+        if not rets:
+            return {"episode_reward_mean": float("nan"), "episodes": 0}
+        return {"episode_reward_mean": float(np.mean(rets[-100:])),
+                "episodes": len(rets)}
+
+
+class DQN(Algorithm):
+    def setup(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+        import ray_tpu as rt
+
+        cfg: DQNConfig = self.config  # type: ignore[assignment]
+        self.module = RLModule(**self.module_spec)
+        self.params = self.module.init(jax.random.PRNGKey(cfg.seed))
+        self.target_params = jax.device_get(self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self._epsilon_step = 0
+        collector_cls = rt.remote(DQNCollector)
+        self.collectors = [
+            collector_cls.options(num_cpus=1).remote(
+                cfg.env, self.module_spec, cfg.num_envs_per_worker,
+                seed=cfg.seed + i + 1)
+            for i in range(cfg.num_rollout_workers)]
+        module, tx, gamma = self.module, self.tx, cfg.gamma
+
+        def td_step(params, target_params, opt_state, batch):
+            def loss_fn(p):
+                q = module.apply(p, batch[sb.OBS])[0]
+                qa = q[jnp.arange(q.shape[0]),
+                       batch[sb.ACTIONS].astype(jnp.int32)]
+                # double-Q: online net argmax, target net value
+                q_next_online = module.apply(p, batch[sb.NEXT_OBS])[0]
+                a_star = jnp.argmax(q_next_online, axis=1)
+                q_next_target = module.apply(target_params,
+                                             batch[sb.NEXT_OBS])[0]
+                target = batch[sb.REWARDS] + gamma * (1 - batch[sb.DONES]) * \
+                    q_next_target[jnp.arange(a_star.shape[0]), a_star]
+                target = jax.lax.stop_gradient(target)
+                return jnp.mean((qa - target) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._td_step = jax.jit(td_step)
+
+    def _epsilon(self) -> float:
+        cfg: DQNConfig = self.config  # type: ignore[assignment]
+        frac = min(1.0, self._epsilon_step / cfg.epsilon_decay_steps)
+        return cfg.epsilon_start + frac * (cfg.epsilon_end -
+                                           cfg.epsilon_start)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import ray_tpu as rt
+        cfg: DQNConfig = self.config  # type: ignore[assignment]
+        weights = jax.device_get(self.params)
+        eps = self._epsilon()
+        batches = rt.get([c.collect.remote(weights,
+                                           cfg.rollout_fragment_length, eps)
+                          for c in self.collectors], timeout=600)
+        for b in batches:
+            self.buffer.add(b)
+            self._timesteps_total += b.count
+            self._epsilon_step += b.count
+        loss = float("nan")
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                self.params, self.opt_state, loss = self._td_step(
+                    self.params, self.target_params, self.opt_state,
+                    dict(mb))
+            if self._timesteps_total % cfg.target_update_freq < \
+                    cfg.rollout_fragment_length * cfg.num_rollout_workers \
+                    * cfg.num_envs_per_worker:
+                self.target_params = jax.device_get(self.params)
+            loss = float(loss)
+        ep = rt.get([c.episode_stats.remote() for c in self.collectors],
+                    timeout=600)
+        means = [s["episode_reward_mean"] for s in ep if s["episodes"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means
+            else float("nan"),
+            "epsilon": eps,
+            "info/td_loss": loss,
+        }
+
+    def get_state(self) -> dict:
+        import jax
+        return {"params": jax.device_get(self.params),
+                "target": self.target_params}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target_params = state["target"]
+
+    def stop(self) -> None:
+        import ray_tpu as rt
+        for c in self.collectors:
+            try:
+                rt.kill(c)
+            except Exception:
+                pass

@@ -1,0 +1,107 @@
+"""IMPALA-style async actor-critic.
+
+Role parity: rllib/algorithms/impala (async sample RPCs feeding a learner,
+impala.py:497-508 LearnerThread role). Sampling is decoupled: each rollout
+worker always has one sample RPC in flight; the driver consumes whichever
+finishes first (rt.wait), updates with an importance-weighted loss
+(clipped-rho correction for the policy lag), and re-dispatches that worker
+with fresh weights. The device-side queue of the reference's
+MultiGPULearnerThread collapses into the jitted update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rl.learner import LearnerGroup, PPOLearner
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_rho = 1.0          # V-trace-style IS clip
+        self.entropy_coeff = 0.01
+        self.vf_loss_coeff = 0.5
+        self.num_sgd_iter = 1        # IMPALA: single pass per batch
+        self.sgd_minibatch_size = 512
+        self.algo_class = Impala
+
+
+class ImpalaLearner(PPOLearner):
+    """Importance-weighted AC update: like the PPO learner but with a
+    one-sided rho clip standing in for V-trace's truncated IS weights
+    (full sequence-level V-trace lands with the recurrent stack)."""
+
+    def __init__(self, *, clip_rho: float = 1.0, **kwargs):
+        kwargs.setdefault("clip_param", clip_rho)
+        super().__init__(**kwargs)
+
+
+class Impala(Algorithm):
+    def setup(self) -> None:
+        cfg: ImpalaConfig = self.config  # type: ignore[assignment]
+        self.learner_group = LearnerGroup(
+            ImpalaLearner,
+            dict(module_spec=self.module_spec, lr=cfg.lr,
+                 clip_rho=cfg.clip_rho, vf_loss_coeff=cfg.vf_loss_coeff,
+                 entropy_coeff=cfg.entropy_coeff,
+                 num_sgd_iter=cfg.num_sgd_iter,
+                 sgd_minibatch_size=cfg.sgd_minibatch_size, seed=cfg.seed),
+            remote=cfg.learner_remote, num_tpus=cfg.learner_num_tpus)
+        self.workers = WorkerSet(cfg, self.module_spec)
+        self._weights_ref = self.workers.sync_weights(
+            self.learner_group.get_weights())
+        # Pipeline: every worker keeps exactly one sample() in flight.
+        self._inflight: Dict[Any, Any] = {}
+        for w in self.workers.workers:
+            self._inflight[w.sample.remote(self._weights_ref)] = w
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu as rt
+        target = self.config.train_batch_size
+        collected = []
+        count = 0
+        stats: Dict[str, float] = {}
+        while count < target:
+            ready, _ = rt.wait(list(self._inflight), num_returns=1,
+                               timeout=600)
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            batch = rt.get(ref)
+            collected.append(batch)
+            count += batch.count
+            # async update per arriving batch (LearnerThread role)
+            stats = self.learner_group.update(batch)
+            self._weights_ref = self.workers.sync_weights(
+                self.learner_group.get_weights())
+            self._inflight[worker.sample.remote(self._weights_ref)] = worker
+        self._timesteps_total += count
+        ep = self.workers.episode_stats()
+        means = [s["episode_reward_mean"] for s in ep if s["episodes"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means
+            else float("nan"),
+            "num_env_steps_sampled": count,
+            **{f"info/{k}": v for k, v in stats.items()},
+        }
+
+    def get_state(self) -> dict:
+        return {"weights": self.learner_group.get_weights()}
+
+    def set_state(self, state: dict) -> None:
+        if self.learner_group.remote:
+            import ray_tpu as rt
+            rt.get(self.learner_group.actor.set_weights.remote(
+                state["weights"]))
+        else:
+            self.learner_group.local.set_weights(state["weights"])
+        self._weights_ref = self.workers.sync_weights(state["weights"])
+
+    def stop(self) -> None:
+        self.workers.stop()
+        self.learner_group.shutdown()

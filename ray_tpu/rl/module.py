@@ -1,0 +1,97 @@
+"""RLModule: the framework-agnostic policy/value network abstraction.
+
+Role parity: rllib/core/rl_module/rl_module.py:215 — one object owning the
+network definition with explicit inference/exploration/train forwards. Here
+it is a pure-functional jax pair (init, apply): apply(params, obs) ->
+(logits, value). Distributions are categorical (discrete) or diagonal
+gaussian (continuous); both sampled with jax PRNG so rollout forwards are
+one jitted batched call.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_init(key, sizes: Sequence[int]) -> list:
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (sizes[i], sizes[i + 1])) * \
+            jnp.sqrt(2.0 / sizes[i])
+        params.append({"w": w.astype(jnp.float32),
+                       "b": jnp.zeros(sizes[i + 1], jnp.float32)})
+    return params
+
+
+def mlp_apply(params: list, x, activate_last: bool = False):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or activate_last:
+            x = jnp.tanh(x)
+    return x
+
+
+class RLModule:
+    """Policy + value MLPs with shared-nothing towers."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions   # -1 => continuous 1-D gaussian
+        self.hiddens = tuple(hiddens)
+        self.out_dim = num_actions if num_actions > 0 else 2
+
+    def init(self, key) -> Dict[str, Any]:
+        kp, kv = jax.random.split(key)
+        return {
+            "pi": mlp_init(kp, (self.obs_dim, *self.hiddens, self.out_dim)),
+            "vf": mlp_init(kv, (self.obs_dim, *self.hiddens, 1)),
+        }
+
+    def apply(self, params, obs):
+        """-> (logits [B, A], value [B])."""
+        logits = mlp_apply(params["pi"], obs)
+        value = mlp_apply(params["vf"], obs)[..., 0]
+        return logits, value
+
+    # -- distribution ops (categorical / gaussian) -----------------------
+    def sample_actions(self, params, obs, key):
+        """-> (actions, logp, value) — one jitted batched call."""
+        logits, value = self.apply(params, obs)
+        if self.num_actions > 0:
+            actions = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), actions]
+        else:
+            mean, log_std = logits[..., 0], logits[..., 1]
+            eps = jax.random.normal(key, mean.shape)
+            actions = mean + jnp.exp(log_std) * eps
+            logp = -0.5 * (eps ** 2 + 2 * log_std +
+                           jnp.log(2 * jnp.pi))
+        return actions, logp, value
+
+    def logp_entropy(self, params, obs, actions):
+        """-> (logp, entropy, value) for train-time evaluation."""
+        logits, value = self.apply(params, obs)
+        if self.num_actions > 0:
+            logp_all = jax.nn.log_softmax(logits)
+            logp = logp_all[jnp.arange(logits.shape[0]),
+                            actions.astype(jnp.int32)]
+            entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        else:
+            mean, log_std = logits[..., 0], logits[..., 1]
+            z = (actions - mean) / jnp.exp(log_std)
+            logp = -0.5 * (z ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            entropy = log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e)
+        return logp, entropy, value
+
+    def greedy_actions(self, params, obs):
+        logits, _ = self.apply(params, obs)
+        if self.num_actions > 0:
+            return jnp.argmax(logits, axis=-1)
+        return logits[..., 0]

@@ -1,0 +1,100 @@
+"""RolloutWorker: CPU actor stepping a vectorized env with a jitted policy.
+
+Role parity: rllib/evaluation/rollout_worker.py:166 (sample():879) +
+env_runner_v2.py — but the inner loop is one jitted batched forward per
+step over the whole vector env (no per-env python policy calls), and GAE
+(postprocessing.py role) is computed vectorized over the [T, N] rollout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.module import RLModule
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+def compute_gae(rewards, values, dones, last_value, gamma: float,
+                lam: float):
+    """Vectorized GAE over [T, N] arrays -> (advantages, value_targets)."""
+    T, N = rewards.shape
+    adv = np.zeros((T, N), dtype=np.float32)
+    lastgaelam = np.zeros(N, dtype=np.float32)
+    for t in reversed(range(T)):
+        nextvalue = last_value if t == T - 1 else values[t + 1]
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * nextvalue * nonterminal - values[t]
+        lastgaelam = delta + gamma * lam * nonterminal * lastgaelam
+        adv[t] = lastgaelam
+    return adv, adv + values
+
+
+class RolloutWorker:
+    """One sampling actor (spawned with JAX_PLATFORMS=cpu by the worker
+    pool, so policy forwards jit onto host CPU)."""
+
+    def __init__(self, env: Any, module_spec: dict, rollout_length: int,
+                 num_envs: int, gamma: float, lam: float, seed: int = 0):
+        import jax
+        self.env = make_env(env, num_envs=num_envs, seed=seed)
+        self.module = RLModule(**module_spec)
+        self.rollout_length = rollout_length
+        self.gamma = gamma
+        self.lam = lam
+        self.key = jax.random.PRNGKey(seed)
+        self.obs = self.env.vector_reset(seed=seed)
+        self._sample_fn = jax.jit(self.module.sample_actions)
+        self._value_fn = jax.jit(
+            lambda p, o: self.module.apply(p, o)[1])
+        self.params = None
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self, params: Optional[Any] = None) -> SampleBatch:
+        """Collect rollout_length * num_envs transitions with GAE."""
+        import jax
+        if params is not None:
+            self.params = params
+        T, N = self.rollout_length, self.env.num_envs
+        obs_buf = np.empty((T, N, self.env.observation_dim), np.float32)
+        act_buf = np.empty((T, N), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), np.float32)
+        logp_buf = np.empty((T, N), np.float32)
+        val_buf = np.empty((T, N), np.float32)
+        for t in range(T):
+            self.key, sub = jax.random.split(self.key)
+            actions, logp, value = self._sample_fn(self.params, self.obs, sub)
+            actions = np.asarray(actions)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self.obs, rew_buf[t], done_buf[t], _ = \
+                self.env.vector_step(actions)
+        last_value = np.asarray(self._value_fn(self.params, self.obs))
+        adv, targets = compute_gae(rew_buf, val_buf, done_buf, last_value,
+                                   self.gamma, self.lam)
+        flat = lambda x: x.reshape(T * N, *x.shape[2:])
+        return SampleBatch({
+            sb.OBS: flat(obs_buf), sb.ACTIONS: flat(act_buf),
+            sb.REWARDS: flat(rew_buf), sb.DONES: flat(done_buf),
+            sb.ACTION_LOGP: flat(logp_buf), sb.VF_PREDS: flat(val_buf),
+            sb.ADVANTAGES: flat(adv), sb.VALUE_TARGETS: flat(targets),
+        })
+
+    def episode_stats(self) -> dict:
+        rets = getattr(self.env, "completed_returns", [])
+        if not rets:
+            return {"episode_reward_mean": float("nan"), "episodes": 0}
+        return {"episode_reward_mean": float(np.mean(rets[-100:])),
+                "episodes": len(rets)}
+
+    def ping(self) -> str:
+        return "pong"

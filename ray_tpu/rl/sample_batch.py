@@ -1,0 +1,55 @@
+"""SampleBatch: columnar trajectory container.
+
+Role parity: rllib/policy/sample_batch.py:96 — a dict of parallel arrays
+(obs, actions, rewards, dones, logp, value targets, advantages) with
+concat/shuffle/minibatch helpers. Kept as plain numpy on the host; the
+learner device_puts whole minibatches (contiguous, static shapes) so XLA
+sees fixed-shape updates.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+NEXT_OBS = "next_obs"
+ACTION_LOGP = "action_logp"
+VF_PREDS = "vf_preds"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+
+
+class SampleBatch(dict):
+    @property
+    def count(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @staticmethod
+    def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
+        if not batches:
+            return SampleBatch()
+        keys = batches[0].keys()
+        return SampleBatch({
+            k: np.concatenate([np.asarray(b[k]) for b in batches])
+            for k in keys})
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        idx = rng.permutation(self.count)
+        return SampleBatch({k: np.asarray(v)[idx] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = self.count
+        for start in range(0, n - size + 1, size):
+            yield SampleBatch({k: np.asarray(v)[start:start + size]
+                               for k, v in self.items()})
+
+    def slice_rows(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: np.asarray(v)[start:end]
+                            for k, v in self.items()})

@@ -1,0 +1,190 @@
+"""CLI: cluster lifecycle + introspection.
+
+Role parity: python/ray/scripts/scripts.py — `ray start/stop/status/
+memory/timeline/summary/list` (start:529) and the `ray microbenchmark`
+driver (_private/ray_perf.py:93). Invoke as ``python -m ray_tpu <cmd>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+ADDRESS_FILE = "/tmp/ray_tpu_last_address"
+PID_FILE = "/tmp/ray_tpu_head_pids"
+
+
+def _write_state(address: str, pids) -> None:
+    with open(ADDRESS_FILE, "w") as f:
+        f.write(address)
+    with open(PID_FILE, "a") as f:
+        for p in pids:
+            f.write(f"{p}\n")
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None)
+    if addr:
+        return addr
+    if os.path.exists(ADDRESS_FILE):
+        return open(ADDRESS_FILE).read().strip()
+    raise SystemExit("no --address given and no running cluster found "
+                     f"({ADDRESS_FILE} missing)")
+
+
+def cmd_start(args) -> None:
+    from ray_tpu.cluster.node_daemon import NodeDaemon
+    resources = {"CPU": float(args.num_cpus)} if args.num_cpus else None
+    if args.num_tpus:
+        resources = resources or {}
+        resources["TPU"] = float(args.num_tpus)
+    if args.head:
+        from ray_tpu.cluster.conductor import Conductor
+        conductor = Conductor(host=args.host, port=args.port)
+        daemon = NodeDaemon(conductor.address, resources=resources,
+                            is_head=True,
+                            object_store_bytes=args.object_store_memory
+                            << 20)
+        _write_state(conductor.address, [os.getpid(),
+                                         daemon.store_proc.pid])
+        print(f"ray_tpu head started. Address: {conductor.address}")
+        print(f"Connect other nodes with:\n  python -m ray_tpu start "
+              f"--address {conductor.address}")
+        print(f"Drive it with:\n  import ray_tpu; "
+              f"ray_tpu.init(address='{conductor.address}')")
+    else:
+        address = _resolve_address(args)
+        daemon = NodeDaemon(address, resources=resources,
+                            object_store_bytes=args.object_store_memory
+                            << 20)
+        _write_state(address, [os.getpid(), daemon.store_proc.pid])
+        print(f"node daemon joined {address} "
+              f"(node_id={daemon.node_id.hex()[:12]})")
+    if args.block or args.head:
+        try:
+            signal.pause()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            daemon.stop()
+
+
+def cmd_stop(args) -> None:
+    import subprocess
+    n = 0
+    if os.path.exists(PID_FILE):
+        for line in open(PID_FILE):
+            try:
+                os.kill(int(line.strip()), signal.SIGTERM)
+                n += 1
+            except (ValueError, ProcessLookupError):
+                pass
+        os.remove(PID_FILE)
+    subprocess.run(["pkill", "-f", "ray_tpu[.]cluster[.]worker_main"],
+                   check=False)
+    if os.path.exists(ADDRESS_FILE):
+        os.remove(ADDRESS_FILE)
+    print(f"stopped {n} processes")
+
+
+def _connect(args):
+    import ray_tpu
+    ray_tpu.init(address=_resolve_address(args))
+    return ray_tpu
+
+
+def cmd_status(args) -> None:
+    rt = _connect(args)
+    nodes = rt.nodes()
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    print(f"Nodes: {sum(1 for n in nodes if n['Alive'])} alive / "
+          f"{len(nodes)} total")
+    for n in nodes:
+        mark = "HEAD" if n.get("is_head") else "    "
+        state = "ALIVE" if n["Alive"] else "DEAD "
+        print(f"  {mark} {state} {n['NodeID'][:12]} {n['address']} "
+              f"{n['Resources']}")
+    print("Resources (available / total):")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g}")
+
+
+def cmd_list(args) -> None:
+    _connect(args)
+    from ray_tpu import state
+    fn = {"actors": state.list_actors, "tasks": state.list_tasks,
+          "nodes": state.list_nodes, "objects": state.list_objects,
+          "placement-groups": state.list_placement_groups}[args.entity]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    _connect(args)
+    from ray_tpu import state
+    print(json.dumps(state.summarize_tasks(), indent=2, default=str))
+
+
+def cmd_timeline(args) -> None:
+    rt = _connect(args)
+    out = args.output or f"/tmp/ray_tpu_timeline_{int(time.time())}.json"
+    rt.timeline(out)
+    print(f"chrome://tracing timeline written to {out}")
+
+
+def cmd_metrics(args) -> None:
+    _connect(args)
+    from ray_tpu.util.metrics import prometheus_text
+    print(prometheus_text())
+
+
+def cmd_microbenchmark(args) -> None:
+    from ray_tpu.cluster.microbench import run_microbenchmark
+    run_microbenchmark(address=getattr(args, "address", None))
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        "ray_tpu", description="TPU-native distributed AI framework CLI")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head node or join a cluster")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6380)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--object-store-memory", type=int, default=1024,
+                   help="MB of shm for the object store")
+    p.add_argument("--block", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop local cluster processes")
+    p.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("summary", cmd_summary),
+                     ("timeline", cmd_timeline), ("metrics", cmd_metrics),
+                     ("microbenchmark", cmd_microbenchmark)):
+        p = sub.add_parser(name)
+        p.add_argument("--address", default=None)
+        if name == "timeline":
+            p.add_argument("--output", default=None)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument("entity", choices=["actors", "tasks", "nodes", "objects",
+                                      "placement-groups"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

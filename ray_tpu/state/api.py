@@ -1,0 +1,80 @@
+"""State API implementation over the conductor tables."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+
+def _conductor():
+    from ray_tpu.core.api import _global_runtime
+    rt = _global_runtime()
+    conductor = getattr(rt, "conductor", None)
+    if conductor is None:
+        raise RuntimeError("state API requires cluster mode (the in-process "
+                           "local runtime keeps no cluster tables)")
+    return conductor
+
+
+def list_nodes() -> List[dict]:
+    return [{
+        "node_id": n["node_id"].hex(),
+        "state": "ALIVE" if n["alive"] else "DEAD",
+        "is_head_node": n["is_head"],
+        "resources_total": n["resources_total"],
+        "resources_available": n["resources_available"],
+        "address": n["address"],
+    } for n in _conductor().call("get_nodes")]
+
+
+def list_actors(state: Optional[str] = None) -> List[dict]:
+    out = _conductor().call("list_actors")
+    if state:
+        out = [a for a in out if a["state"] == state]
+    return out
+
+
+def list_tasks(limit: int = 1000) -> List[dict]:
+    events = _conductor().call("get_task_events")
+    return [{
+        "task_id": e["task_id"], "name": e["name"], "type": e["kind"],
+        "state": "FAILED" if e["error"] else "FINISHED",
+        "start_time_s": e["start"], "end_time_s": e["end"],
+        "duration_s": e["end"] - e["start"],
+        "node_id": e["node_id"], "worker_pid": e["pid"],
+        "error_message": e["error"],
+    } for e in events[-limit:]]
+
+
+def list_objects() -> List[dict]:
+    """Per-node store contents (store stats + object list via daemons)."""
+    from ray_tpu.cluster.protocol import get_client
+    out = []
+    for n in _conductor().call("get_nodes"):
+        if not n["alive"]:
+            continue
+        try:
+            stats = get_client(n["address"]).call("store_stats")
+        except Exception:
+            continue
+        out.append({"node_id": n["node_id"].hex(), **stats})
+    return out
+
+
+def list_placement_groups() -> List[dict]:
+    return _conductor().call("list_placement_groups")
+
+
+def summarize_tasks() -> Dict[str, dict]:
+    """Group task events by name (parity: `ray summary tasks`)."""
+    events = _conductor().call("get_task_events")
+    agg: Dict[str, dict] = defaultdict(
+        lambda: {"count": 0, "failed": 0, "total_time_s": 0.0})
+    for e in events:
+        row = agg[e["name"]]
+        row["count"] += 1
+        row["failed"] += 1 if e["error"] else 0
+        row["total_time_s"] += e["end"] - e["start"]
+    for row in agg.values():
+        row["mean_time_s"] = row["total_time_s"] / max(1, row["count"])
+    return dict(agg)

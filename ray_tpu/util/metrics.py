@@ -1,0 +1,181 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Role parity: python/ray/util/metrics.py (Cython metric.pxi + OpenCensus
+export behind it). Metrics register in a per-process registry; a background
+flusher ships them to the conductor KV under the "metrics" namespace, and
+``prometheus_text()`` renders the cluster-wide scrape payload (the role of
+the per-node MetricsAgent -> Prometheus pipeline,
+_private/metrics_agent.py:375).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_registry: Dict[str, "Metric"] = {}
+_registry_lock = threading.Lock()
+_flusher_started = False
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        if not name.replace("_", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_tuple(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple((k, merged.get(k, "")) for k in self.tag_keys)
+
+    def _points(self) -> List[Tuple[Tuple, float]]:
+        with self._lock:
+            return list(self._values.items())
+
+    kind = "gauge"
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._tag_tuple(tags)] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100])
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._tag_tuple(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            idx = len(self.boundaries)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._values[key] = value  # last observation (gauge view)
+
+    def _hist_points(self):
+        with self._lock:
+            return ({k: list(v) for k, v in self._counts.items()},
+                    dict(self._sums))
+
+
+def _snapshot() -> dict:
+    out = {}
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        entry = {"kind": m.kind, "description": m.description,
+                 "points": [(list(k), v) for k, v in m._points()]}
+        if isinstance(m, Histogram):
+            counts, sums = m._hist_points()
+            entry["histogram"] = {
+                "boundaries": m.boundaries,
+                "counts": {str(list(k)): v for k, v in counts.items()},
+                "sums": {str(list(k)): v for k, v in sums.items()},
+            }
+        out[m.name] = entry
+    return out
+
+
+def _flush_once() -> None:
+    import pickle
+    try:
+        from ray_tpu.core.api import _global_runtime, is_initialized
+        if not is_initialized():
+            return
+        rt = _global_runtime()
+        conductor = getattr(rt, "conductor", None)
+        if conductor is None:
+            return
+        conductor.call("kv_put", ns="metrics",
+                       key=f"proc-{os.getpid()}".encode(),
+                       value=pickle.dumps(_snapshot(), protocol=5))
+    except Exception:
+        pass
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    if _flusher_started:
+        return
+    _flusher_started = True
+
+    def loop():
+        from ray_tpu import config
+        while True:
+            time.sleep(config.get("metrics_export_period_s"))
+            _flush_once()
+
+    threading.Thread(target=loop, daemon=True, name="metrics-flush").start()
+
+
+def prometheus_text() -> str:
+    """Render every process's shipped metrics in Prometheus exposition
+    format (scrape endpoint payload)."""
+    import pickle
+    from ray_tpu.core.api import _global_runtime
+    rt = _global_runtime()
+    conductor = rt.conductor
+    _flush_once()
+    lines: List[str] = []
+    seen_help = set()
+    for key in conductor.call("kv_keys", ns="metrics"):
+        blob = conductor.call("kv_get", ns="metrics", key=key)
+        if blob is None:
+            continue
+        snap = pickle.loads(blob)
+        for name, entry in snap.items():
+            if name not in seen_help:
+                lines.append(f"# HELP {name} {entry['description']}")
+                lines.append(f"# TYPE {name} {entry['kind']}")
+                seen_help.add(name)
+            for tags, value in entry["points"]:
+                label = ",".join(f'{k}="{v}"' for k, v in tags)
+                label = "{" + label + "}" if label else ""
+                lines.append(f"{name}{label} {value}")
+    return "\n".join(lines) + "\n"

@@ -202,6 +202,7 @@ def test_async_actor(cluster):
             return t
 
     a = AsyncWorker.options(max_concurrency=4).remote()
+    rt.get(a.work.remote(0.0), timeout=30)  # warm: actor cold-start ~2s
     start = time.time()
     refs = [a.work.remote(0.3) for _ in range(4)]
     assert rt.get(refs, timeout=30) == [0.3] * 4
