@@ -1,0 +1,19 @@
+"""ray_tpu.autoscaler — load-driven cluster scaling.
+
+Parity surface: reference python/ray/autoscaler — StandardAutoscaler
+(_private/autoscaler.py:172), bin-packing ResourceDemandScheduler
+(_private/resource_demand_scheduler.py:101), pluggable NodeProvider
+(node_provider.py) with the fake in-process provider
+(_private/fake_multi_node/) for tests.
+
+TPU-first: a node type carries a ``topology`` (e.g. "v4-8") — scaling up a
+TPU type means provisioning a whole ICI slice's hosts at once (slice
+granularity, not per-VM), which is how TPU capacity actually arrives.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (FakeNodeProvider, NodeProvider,
+                                           StandardAutoscaler,
+                                           fit_demand)
+
+__all__ = ["StandardAutoscaler", "NodeProvider", "FakeNodeProvider",
+           "fit_demand"]

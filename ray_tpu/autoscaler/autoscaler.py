@@ -1,0 +1,205 @@
+"""Autoscaler core: demand bin-packing + provider reconciliation."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class NodeProvider:
+    """Cloud abstraction (parity: autoscaler/node_provider.py)."""
+
+    def create_node(self, node_type: str) -> str:
+        """Launch one node of ``node_type``; returns provider node id."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[Tuple[str, str]]:
+        """-> [(provider_id, node_type)]."""
+        raise NotImplementedError
+
+    def node_id_map(self) -> Dict[bytes, str]:
+        """cluster node_id -> provider_id, for scale-down. Providers that
+        cannot map (yet) return {} and opt out of termination."""
+        return {}
+
+
+class FakeNodeProvider(NodeProvider):
+    """In-process provider: "launching a node" starts a NodeDaemon thread
+    against the conductor (parity: _private/fake_multi_node)."""
+
+    def __init__(self, conductor_address: str,
+                 node_types: Dict[str, Dict[str, float]]):
+        self.conductor_address = conductor_address
+        self.node_types = node_types
+        self._nodes: Dict[str, tuple] = {}   # provider_id -> (daemon, type)
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str) -> str:
+        from ray_tpu.cluster.node_daemon import NodeDaemon
+        resources = dict(self.node_types[node_type]["resources"])
+        daemon = NodeDaemon(self.conductor_address, resources=resources,
+                            object_store_bytes=64 << 20)
+        with self._lock:
+            self._counter += 1
+            pid = f"fake-{node_type}-{self._counter}"
+            self._nodes[pid] = (daemon, node_type)
+        return pid
+
+    def terminate_node(self, provider_id: str) -> None:
+        with self._lock:
+            entry = self._nodes.pop(provider_id, None)
+        if entry:
+            entry[0].stop()
+
+    def non_terminated_nodes(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return [(pid, t) for pid, (d, t) in self._nodes.items()]
+
+    def daemon_node_id(self, provider_id: str) -> Optional[bytes]:
+        entry = self._nodes.get(provider_id)
+        return entry[0].node_id if entry else None
+
+    def node_id_map(self) -> Dict[bytes, str]:
+        with self._lock:
+            return {d.node_id: pid for pid, (d, t) in self._nodes.items()}
+
+
+def _fits(avail: Dict[str, float], shape: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in shape.items()
+               if v > 0)
+
+
+def _take(avail: Dict[str, float], shape: Dict[str, float]) -> None:
+    for k, v in shape.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def fit_demand(demand: List[Dict[str, float]],
+               node_avail: List[Dict[str, float]],
+               node_types: Dict[str, dict],
+               max_per_type: Optional[Dict[str, int]] = None
+               ) -> Dict[str, int]:
+    """Bin-pack pending demand onto existing capacity; whatever is left
+    maps to new nodes by type (parity: resource_demand_scheduler.py:101
+    get_nodes_to_launch)."""
+    avail = [dict(a) for a in node_avail]
+    unmet: List[Dict[str, float]] = []
+    for shape in demand:
+        placed = False
+        for a in avail:
+            if _fits(a, shape):
+                _take(a, shape)
+                placed = True
+                break
+        if not placed:
+            unmet.append(shape)
+    to_launch: Dict[str, int] = {}
+    virtual: List[Dict[str, float]] = []
+    for shape in unmet:
+        placed = False
+        for v in virtual:
+            if _fits(v, shape):
+                _take(v, shape)
+                placed = True
+                break
+        if placed:
+            continue
+        for tname, tcfg in node_types.items():
+            res = tcfg["resources"]
+            cap = (max_per_type or {}).get(
+                tname, tcfg.get("max_workers", 10))
+            if to_launch.get(tname, 0) >= cap:
+                continue
+            if _fits(dict(res), shape):
+                to_launch[tname] = to_launch.get(tname, 0) + 1
+                fresh = dict(res)
+                _take(fresh, shape)
+                virtual.append(fresh)
+                placed = True
+                break
+        # unplaceable on any type -> dropped (infeasible demand)
+    return to_launch
+
+
+class StandardAutoscaler:
+    """Reconcile loop (parity: autoscaler.py:172 StandardAutoscaler.update):
+    read load from the conductor, launch nodes for unmet demand, terminate
+    nodes idle past the timeout."""
+
+    def __init__(self, conductor_address: str, provider: NodeProvider,
+                 node_types: Dict[str, dict],
+                 idle_timeout_s: float = 30.0,
+                 update_interval_s: float = 1.0,
+                 max_workers: int = 20):
+        from ray_tpu.cluster.protocol import get_client
+        self.conductor = get_client(conductor_address)
+        self.provider = provider
+        self.node_types = node_types
+        self.idle_timeout_s = idle_timeout_s
+        self.update_interval_s = update_interval_s
+        self.max_workers = max_workers
+        self._idle_since: Dict[bytes, float] = {}
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def update(self) -> Dict[str, int]:
+        """One reconcile pass; returns what was launched."""
+        load = self.conductor.call("cluster_load")
+        workers = self.provider.non_terminated_nodes()
+        launched: Dict[str, int] = {}
+        if len(workers) < self.max_workers:
+            # per-type caps are cluster-wide: subtract what already runs
+            existing: Dict[str, int] = {}
+            for _, t in workers:
+                existing[t] = existing.get(t, 0) + 1
+            caps = {t: max(0, cfg.get("max_workers", 10) -
+                           existing.get(t, 0))
+                    for t, cfg in self.node_types.items()}
+            to_launch = fit_demand(
+                load["demand"],
+                [n["resources_available"] for n in load["nodes"]],
+                self.node_types, max_per_type=caps)
+            for tname, count in to_launch.items():
+                for _ in range(count):
+                    if len(workers) + sum(launched.values()) >= \
+                            self.max_workers:
+                        break
+                    self.provider.create_node(tname)
+                    launched[tname] = launched.get(tname, 0) + 1
+        # scale down: terminate provider nodes idle past the timeout
+        now = time.monotonic()
+        by_node_id = self.provider.node_id_map()
+        for n in load["nodes"]:
+            nid = n["node_id"]
+            if n["is_head"] or nid not in by_node_id:
+                continue
+            idle = n["resources_available"] == n["resources_total"] and \
+                not load["demand"]
+            if idle:
+                since = self._idle_since.setdefault(nid, now)
+                if now - since > self.idle_timeout_s:
+                    self.provider.terminate_node(by_node_id[nid])
+                    self._idle_since.pop(nid, None)
+            else:
+                self._idle_since.pop(nid, None)
+        return launched
+
+    def start(self) -> None:
+        def loop():
+            while not self._stopped:
+                try:
+                    self.update()
+                except Exception:
+                    pass
+                time.sleep(self.update_interval_s)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped = True

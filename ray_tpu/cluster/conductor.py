@@ -101,14 +101,39 @@ class Conductor:
         return {"ok": True}
 
     def rpc_heartbeat(self, node_id: bytes,
-                      resources_available: Dict[str, float]) -> dict:
+                      resources_available: Dict[str, float],
+                      pending_demand: Optional[List[Dict[str, float]]] = None
+                      ) -> dict:
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info["alive"]:
                 return {"ok": False, "reregister": True}
             info["last_heartbeat"] = time.monotonic()
             info["resources_available"] = dict(resources_available)
+            info["pending_demand"] = list(pending_demand or [])
         return {"ok": True}
+
+    def rpc_cluster_load(self) -> dict:
+        """Autoscaler input (parity: the GCS load report monitor.py reads):
+        per-shape pending demand + per-node availability."""
+        with self._lock:
+            demand: List[Dict[str, float]] = []
+            nodes = []
+            for info in self._nodes.values():
+                if not info["alive"]:
+                    continue
+                demand.extend(info.get("pending_demand", []))
+                nodes.append({
+                    "node_id": info["node_id"],
+                    "resources_total": dict(info["resources_total"]),
+                    "resources_available": dict(info["resources_available"]),
+                    "is_head": info["is_head"],
+                })
+            # unplaceable pending placement groups are demand too
+            for pg in self._pgs.values():
+                if pg.state == "PENDING":
+                    demand.extend(pg.bundles)
+        return {"demand": demand, "nodes": nodes}
 
     def rpc_drain_node(self, node_id: bytes) -> dict:
         self._mark_node_dead(node_id, "drained")
@@ -463,21 +488,22 @@ class Conductor:
                 if a is None:
                     return {"state": "UNKNOWN"}
                 if a.state in (ALIVE, DEAD) or wait_alive_timeout <= 0:
-                    return {"state": a.state, "address": a.address,
-                            "node_id": a.node_id,
-                            "incarnation": a.incarnation,
-                            "death_reason": a.death_reason,
-                            "creation_error": a.spec.get("creation_error"),
-                            "class_name": a.spec.get("class_name", "")}
+                    return self._actor_info_of(a)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return {"state": a.state, "address": a.address,
-                            "node_id": a.node_id,
-                            "incarnation": a.incarnation,
-                            "death_reason": a.death_reason,
-                            "creation_error": a.spec.get("creation_error"),
-                            "class_name": a.spec.get("class_name", "")}
+                    return self._actor_info_of(a)
                 self._cv.wait(min(remaining, 1.0))
+
+    @staticmethod
+    def _actor_info_of(a: "ActorInfo") -> dict:
+        return {"state": a.state, "address": a.address,
+                "node_id": a.node_id,
+                "incarnation": a.incarnation,
+                "death_reason": a.death_reason,
+                "creation_error": a.spec.get("creation_error"),
+                "class_name": a.spec.get("class_name", ""),
+                "methods": a.spec.get("methods"),
+                "is_async": a.spec.get("is_async", False)}
 
     def rpc_get_named_actor(self, name: str, namespace: str = "default") -> Optional[bytes]:
         with self._lock:

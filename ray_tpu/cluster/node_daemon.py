@@ -87,6 +87,8 @@ class NodeDaemon:
         self._bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self._bundle_state: Dict[Tuple[bytes, int], str] = {}  # PREPARED|COMMITTED
         self._bundle_used: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+        self._pending_demand: List[Dict[str, float]] = []
+        self._infeasible_recent: Dict[tuple, float] = {}
         self._stopped = False
         self.server = RpcServer(self, host=host)
         self.address = self.server.address
@@ -109,9 +111,11 @@ class NodeDaemon:
         while not self._stopped:
             with self._lock:
                 avail = dict(self._avail)
+                demand = [dict(d) for d in self._pending_demand]
             try:
                 cli.call("heartbeat", node_id=self.node_id,
-                         resources_available=avail)
+                         resources_available=avail,
+                         pending_demand=demand)
             except Exception:
                 pass
             time.sleep(0.5)
@@ -294,21 +298,40 @@ class NodeDaemon:
         resources = {k: v for k, v in resources.items() if v > 0}
         avail_fn, take, _ = self._resource_pool_for(strategy)
         deadline = time.monotonic() + wait_timeout
+        demand_entry = dict(resources)
         with self._cv:
             # Infeasible on this node entirely -> immediate spillback hint.
             if not isinstance(strategy, dict) or strategy.get("type") != "pg":
                 if any(self.total_resources.get(k, 0.0) + 1e-9 < v
                        for k, v in resources.items()):
+                    # Register infeasible-here demand for the autoscaler,
+                    # deduped per shape: spillback probes repeat every few
+                    # hundred ms and must not stack into phantom demand.
+                    shape_key = tuple(sorted(resources.items()))
+                    now = time.monotonic()
+                    if now - self._infeasible_recent.get(shape_key, 0) > 1.0:
+                        self._infeasible_recent[shape_key] = now
+                        self._pending_demand.append(demand_entry)
+                        threading.Timer(1.0, self._drop_demand,
+                                        (demand_entry,)).start()
                     return {"granted": False, "infeasible": True}
-            while True:
-                a = avail_fn()
-                if all(a.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()):
-                    take(resources)
-                    break
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return {"granted": False, "infeasible": False}
-                self._cv.wait(min(remaining, 0.5))
+            self._pending_demand.append(demand_entry)
+            try:
+                while True:
+                    a = avail_fn()
+                    if all(a.get(k, 0.0) + 1e-9 >= v
+                           for k, v in resources.items()):
+                        take(resources)
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return {"granted": False, "infeasible": False}
+                    self._cv.wait(min(remaining, 0.5))
+            finally:
+                try:
+                    self._pending_demand.remove(demand_entry)
+                except ValueError:
+                    pass
         env_key = self._env_key_of(runtime_env)
         w = self._checkout_worker(env_key, runtime_env)
         if w is None:
@@ -327,6 +350,13 @@ class NodeDaemon:
         return {"granted": True, "lease_id": lease_id,
                 "worker_address": w.address, "worker_pid": w.pid,
                 "node_id": self.node_id}
+
+    def _drop_demand(self, entry: Dict[str, float]) -> None:
+        with self._lock:
+            try:
+                self._pending_demand.remove(entry)
+            except ValueError:
+                pass
 
     def _release_lease_resources(self, w: _Worker) -> None:
         with self._cv:

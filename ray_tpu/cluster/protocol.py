@@ -25,6 +25,29 @@ class RpcError(Exception):
     pass
 
 
+def _maybe_inject_delay(method: str) -> None:
+    """Deterministic chaos-testing delay (parity: the reference's
+    RAY_testing_asio_delay_us flag, ray_config_def.h:762, used by
+    test_chaos.py to stretch 2PC windows). Set config
+    ``testing_rpc_delay_us`` to "<us>" for all methods or
+    "<method>:<us>[,<method>:<us>...]" to target specific RPCs."""
+    import time as _time
+
+    from ray_tpu import config as _config
+    spec = _config.get("testing_rpc_delay_us")
+    if not spec:
+        return
+    spec = str(spec)
+    if ":" in spec:
+        for part in spec.split(","):
+            name, _, us = part.partition(":")
+            if name == method and us.isdigit():
+                _time.sleep(int(us) / 1e6)
+                return
+    elif spec.isdigit() and int(spec):
+        _time.sleep(int(spec) / 1e6)
+
+
 class ConnectionLost(RpcError):
     pass
 
@@ -60,6 +83,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             try:
                 method, kwargs = pickle.loads(req)
+                _maybe_inject_delay(method)
                 fn = getattr(service, "rpc_" + method, None)
                 if fn is None:
                     resp = (False, RpcError(f"no such method: {method}"))

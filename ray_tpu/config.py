@@ -116,9 +116,9 @@ define("health_check_period_s", float, 1.0, "Conductor -> node liveness ping per
 define("health_check_timeout_s", float, 10.0, "Misses before a node is marked dead.")
 define("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
 define("actor_max_restarts_default", int, 0, "Default actor restarts.")
-define("testing_rpc_delay_us", int, 0,
+define("testing_rpc_delay_us", str, "",
        "Deterministic delay injected before serving matching RPCs; format "
-       "'method=us' pairs comma-separated, or bare int for all methods "
+       "'method:us' pairs comma-separated, or bare int for all methods "
        "(reference: RAY_testing_asio_delay_us).")
 
 # Transport

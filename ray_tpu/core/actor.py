@@ -122,6 +122,11 @@ class ActorClass:
         return rt.create_actor(desc, blob, args, kwargs, self._opts, methods,
                                is_async)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (parity: class_node.py:16 via .bind())."""
+        from ray_tpu.dag.nodes import ClassNode
+        return ClassNode(self, args, kwargs)
+
     def __call__(self, *a, **k):
         raise TypeError(
             f"Actor class {self._cls.__name__!r} cannot be instantiated "

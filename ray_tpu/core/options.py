@@ -20,7 +20,7 @@ class TaskOptions:
     num_tpus: float = 0.0
     resources: Dict[str, float] = field(default_factory=dict)
     num_returns: int = 1
-    max_retries: int = -1          # -1 = use config default
+    max_retries: Any = None        # None = config default; -1 = infinite
     retry_exceptions: Any = False  # bool or tuple of exception types
     name: str = ""
     scheduling_strategy: Any = None

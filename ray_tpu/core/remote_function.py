@@ -51,6 +51,11 @@ class RemoteFunction:
             f"Remote function {self._fn.__qualname__!r} cannot be called "
             "directly; use .remote() (or access the original via .func).")
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (parity: function_node.py:12 via .bind())."""
+        from ray_tpu.dag.nodes import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     @property
     def func(self):
         return self._fn

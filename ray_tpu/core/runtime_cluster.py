@@ -688,8 +688,9 @@ class ClusterRuntime:
                      **opts.resources}
         resources = {k: v for k, v in resources.items() if v > 0}
         strategy = self._strategy_dict(opts.scheduling_strategy)
+        # None -> config default; -1 -> retry forever (reference semantics)
         max_retries = opts.max_retries
-        if max_retries == -1:
+        if max_retries is None:
             max_retries = config.get("task_max_retries_default")
         task = {
             "task_id": task_id.binary(),
@@ -726,6 +727,7 @@ class ClusterRuntime:
             "class_name": desc.repr_name(),
             "args_blob": args_blob,
             "is_async": is_async,
+            "methods": methods,
             "opts": {
                 "name": opts.name, "namespace": opts.namespace or self.namespace,
                 "max_restarts": opts.max_restarts,
@@ -754,10 +756,16 @@ class ClusterRuntime:
     def _handle_for(self, actor_id: bytes) -> ActorHandle:
         meta = self._actor_meta.get(actor_id)
         if meta is None:
+            # Cross-process lookup (rt.get_actor in another worker): the
+            # method table was persisted with the actor spec at
+            # registration so handles work from any process.
             info = self.conductor.call("get_actor_info", actor_id=actor_id)
-            meta = {"methods": {}, "is_async": False,
+            meta = {"methods": info.get("methods") or {},
+                    "is_async": info.get("is_async", False),
                     "class_name": info.get("class_name", ""),
                     "max_task_retries": 0}
+            with self._lock:
+                self._actor_meta[actor_id] = meta
         return ActorHandle(ActorID(actor_id), meta["class_name"],
                            meta["methods"], meta["is_async"])
 

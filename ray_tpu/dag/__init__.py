@@ -1,0 +1,10 @@
+"""Lazy call graphs (parity: python/ray/dag — DAGNode dag_node.py:23,
+FunctionNode function_node.py:12, ClassNode class_node.py:16, InputNode
+input_node.py:13). Build with ``fn.bind(...)``, execute with
+``dag.execute(input)``; nodes memoize within one execution."""
+
+from ray_tpu.dag.nodes import (ClassMethodNode, ClassNode, DAGNode,
+                               FunctionNode, InputNode)
+
+__all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
+           "InputNode"]

@@ -1,0 +1,121 @@
+"""DAG node types + executor."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal -------------------------------------------------------
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for v in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(v, DAGNode):
+                out.append(v)
+        return out
+
+    def _resolve_args(self, memo: Dict[int, Any], input_value) -> Tuple:
+        def rv(v):
+            if isinstance(v, DAGNode):
+                return v._execute_memo(memo, input_value)
+            return v
+        args = tuple(rv(a) for a in self._bound_args)
+        kwargs = {k: rv(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_memo(self, memo: Dict[int, Any], input_value):
+        key = id(self)
+        if key in memo:
+            return memo[key]
+        out = self._execute_impl(memo, input_value)
+        memo[key] = out
+        return out
+
+    def _execute_impl(self, memo, input_value):
+        raise NotImplementedError
+
+    def execute(self, input_value: Any = None):
+        """Execute the graph; returns the root's result (ObjectRefs are
+        resolved at the boundary)."""
+        import ray_tpu as rt
+        out = self._execute_memo({}, input_value)
+        from ray_tpu.core.refs import ObjectRef
+        return rt.get(out) if isinstance(out, ObjectRef) else out
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to execute() (input_node.py:13)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def _execute_impl(self, memo, input_value):
+        return input_value
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, memo, input_value):
+        import ray_tpu as rt
+        from ray_tpu.core.refs import ObjectRef
+        args, kwargs = self._resolve_args(memo, input_value)
+        # materialize upstream refs are fine as args (worker resolves)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """A bound actor-to-be; method .bind() produces ClassMethodNodes."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._actor_handle = None
+
+    def _execute_impl(self, memo, input_value):
+        if self._actor_handle is None:
+            import ray_tpu as rt
+            args, kwargs = self._resolve_args(memo, input_value)
+            from ray_tpu.core.refs import ObjectRef
+            args = tuple(rt.get(a) if isinstance(a, ObjectRef) else a
+                         for a in args)
+            self._actor_handle = self._actor_cls.remote(*args, **kwargs)
+        return self._actor_handle
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodBinder(self, name)
+
+
+class _MethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._class_node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method = method
+
+    def _execute_impl(self, memo, input_value):
+        handle = self._class_node._execute_memo(memo, input_value)
+        args, kwargs = self._resolve_args(memo, input_value)
+        return getattr(handle, self._method).remote(*args, **kwargs)

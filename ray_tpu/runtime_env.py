@@ -1,0 +1,53 @@
+"""RuntimeEnv: per-task/actor environment spec.
+
+Role parity: python/ray/runtime_env/runtime_env.py — a validated dict of
+environment customizations applied when the worker pool spawns a process
+for that env (node_daemon._spawn_worker): ``env_vars`` merge into the
+worker's environment, ``working_dir`` becomes its cwd. Workers are cached
+per runtime-env hash (the reference's dedicated-worker behavior).
+
+Unsupported-in-this-image plugins (pip/conda/container) raise upfront
+rather than failing inside the worker pool.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+_SUPPORTED = {"env_vars", "working_dir"}
+_KNOWN_UNSUPPORTED = {"pip", "conda", "container", "py_modules"}
+
+
+class RuntimeEnv(dict):
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        if env_vars is not None:
+            if not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env_vars.items()):
+                raise TypeError("env_vars must be Dict[str, str]")
+            self["env_vars"] = dict(env_vars)
+        if working_dir is not None:
+            import os
+            if not os.path.isdir(working_dir):
+                raise ValueError(
+                    f"working_dir {working_dir!r} is not a directory")
+            self["working_dir"] = working_dir
+        for k in kwargs:
+            if k in _KNOWN_UNSUPPORTED:
+                raise ValueError(
+                    f"runtime_env field {k!r} requires package installation "
+                    "at runtime, which this deployment image disallows; "
+                    "bake dependencies into the image instead")
+            raise ValueError(f"unknown runtime_env field {k!r}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self)
+
+
+def validate_runtime_env(env: Optional[dict]) -> Optional[dict]:
+    if env is None:
+        return None
+    if isinstance(env, RuntimeEnv):
+        return env.to_dict()
+    return RuntimeEnv(**env).to_dict()

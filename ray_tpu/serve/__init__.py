@@ -1,0 +1,20 @@
+"""ray_tpu.serve — model-serving library.
+
+Parity surface: reference python/ray/serve — ServeController singleton
+actor (controller.py:73) reconciling deployments into replica actors
+(_private/deployment_state.py), HTTP proxy (_private/http_proxy.py:250),
+queue-aware handle routing (_private/router.py:263), dynamic request
+batching (@serve.batch), deployment autoscaling
+(_private/autoscaling_policy.py).
+
+TPU-first: a deployment replica can pin TPU chips (num_tpus in
+ray_actor_options) and @serve.batch turns concurrent requests into one
+batched jitted forward — the serving analog of keeping the MXU fed.
+"""
+
+from ray_tpu.serve.api import (Application, Deployment, batch, delete,
+                               deployment, get_deployment_handle, run,
+                               shutdown, status)
+
+__all__ = ["deployment", "run", "delete", "shutdown", "status",
+           "get_deployment_handle", "batch", "Deployment", "Application"]

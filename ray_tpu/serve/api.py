@@ -1,0 +1,311 @@
+"""Public serve API: @deployment / run / handles / @batch.
+
+Role parity: serve/api.py + handle.py:78 (DeploymentHandle -> Router) +
+batching (serve/batching.py). Handle routing is queue-length-aware
+power-of-two-choices over replica actors (parity: router.py:263 picks the
+replica with fewest in-flight)."""
+
+from __future__ import annotations
+
+import functools
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+
+def _get_controller(create: bool = True):
+    import ray_tpu as rt
+    from ray_tpu.serve.controller import ServeController
+    try:
+        return rt.get_actor(ServeController.CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise
+        cls = rt.remote(ServeController)
+        return cls.options(name=ServeController.CONTROLLER_NAME,
+                           lifetime="detached", max_concurrency=32,
+                           get_if_exists=True).remote()
+
+
+class DeploymentHandle:
+    """Client-side router over a deployment's replicas."""
+
+    def __init__(self, name: str, method: str = "__call__"):
+        self.name = name
+        self.method = method
+        self._replicas: List[Any] = []
+        self._ts = 0.0
+        self._lock = threading.Lock()
+        self._inflight: Dict[Any, int] = {}
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self.name, method_name)
+
+    def _refresh(self):
+        import ray_tpu as rt
+        with self._lock:
+            if time.monotonic() - self._ts < 1.0 and self._replicas:
+                return
+            controller = _get_controller(create=False)
+            self._replicas = rt.get(
+                controller.get_replicas.remote(self.name), timeout=30)
+            self._ts = time.monotonic()
+
+    def _pick(self):
+        """Power-of-two-choices on locally tracked in-flight counts."""
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(f"deployment {self.name!r} has no replicas")
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        with self._lock:
+            return a if self._inflight.get(a._rt_actor_id, 0) <= \
+                self._inflight.get(b._rt_actor_id, 0) else b
+
+    def remote(self, *args, **kwargs):
+        replica = self._pick()
+        key = replica._rt_actor_id
+        with self._lock:
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+        args_blob = cloudpickle.dumps((args, kwargs))
+        ref = replica.handle_request.remote(self.method, args_blob)
+        # Decrement when the request actually completes (the ref resolves);
+        # a single drainer thread per handle watches all outstanding refs.
+        self._track(ref, key)
+        return ref
+
+    def _track(self, ref, key) -> None:
+        with self._lock:
+            if not hasattr(self, "_outstanding"):
+                self._outstanding = []
+                threading.Thread(target=self._drain_loop, daemon=True,
+                                 name=f"serve-drain-{self.name}").start()
+            self._outstanding.append((ref, key))
+
+    def _drain_loop(self) -> None:
+        import ray_tpu as rt
+        while True:
+            with self._lock:
+                pending = list(self._outstanding)
+            if not pending:
+                time.sleep(0.02)
+                continue
+            done, _ = rt.wait([r for r, _ in pending],
+                              num_returns=1, timeout=1.0)
+            if done:
+                done_set = set(done)
+                with self._lock:
+                    still = []
+                    for r, k in self._outstanding:
+                        if r in done_set:
+                            self._inflight[k] = max(
+                                0, self._inflight.get(k, 1) - 1)
+                        else:
+                            still.append((r, k))
+                    self._outstanding = still
+
+
+class Deployment:
+    """Result of @serve.deployment: holds the target + config, bindable."""
+
+    def __init__(self, target, name: str, num_replicas: int = 1,
+                 ray_actor_options: Optional[dict] = None,
+                 user_config=None, route_prefix: Optional[str] = None,
+                 max_concurrent_queries: int = 100,
+                 autoscaling_config: Optional[dict] = None):
+        self._target = target
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = ray_actor_options or {}
+        self.user_config = user_config
+        self.route_prefix = route_prefix if route_prefix is not None \
+            else f"/{name}"
+        self.max_concurrent_queries = max_concurrent_queries
+        self.autoscaling_config = autoscaling_config
+        self._init_args = ((), {})
+
+    def options(self, **updates) -> "Deployment":
+        d = Deployment(self._target, updates.pop("name", self.name),
+                       self.num_replicas, dict(self.ray_actor_options),
+                       self.user_config, self.route_prefix,
+                       self.max_concurrent_queries, self.autoscaling_config)
+        for k, v in updates.items():
+            setattr(d, k, v)
+        d._init_args = self._init_args
+        return d
+
+    def bind(self, *args, **kwargs) -> "Application":
+        d = self.options()
+        d._init_args = (args, kwargs)
+        return Application(d)
+
+    def deploy(self, *init_args, **init_kwargs) -> DeploymentHandle:
+        import ray_tpu as rt
+        controller = _get_controller()
+        rt.get(controller.deploy.remote(
+            self.name, cloudpickle.dumps(self._target),
+            cloudpickle.dumps((init_args, init_kwargs)),
+            self.num_replicas, self.ray_actor_options, self.user_config,
+            self.route_prefix, self.max_concurrent_queries,
+            self.autoscaling_config), timeout=300)
+        return DeploymentHandle(self.name)
+
+
+class Application:
+    def __init__(self, deployment: Deployment):
+        self.deployment = deployment
+
+
+def deployment(target=None, *, name: Optional[str] = None, **config):
+    """@serve.deployment decorator over a class or function."""
+    def wrap(t):
+        return Deployment(t, name or t.__name__, **config)
+    if target is not None:
+        return wrap(target)
+    return wrap
+
+
+def run(app, *, http_host: Optional[str] = None,
+        http_port: int = 0) -> DeploymentHandle:
+    """Deploy an Application (parity: serve.run)."""
+    import ray_tpu as rt
+    if isinstance(app, Deployment):
+        app = app.bind()
+    d = app.deployment
+    args, kwargs = d._init_args
+    handle = d.deploy(*args, **kwargs)
+    if http_host is not None:
+        controller = _get_controller()
+        port = rt.get(controller.start_http.remote(http_host, http_port),
+                      timeout=120)
+        handle.http_port = port
+    # wait for replicas to come up
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            handle._refresh()
+            if handle._replicas:
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    return handle
+
+
+def get_deployment_handle(name: str, method: str = "__call__"
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(name, method)
+
+
+def _handle_for(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, dict]:
+    import ray_tpu as rt
+    return rt.get(_get_controller(create=False).status.remote(), timeout=30)
+
+
+def delete(name: str) -> None:
+    import ray_tpu as rt
+    rt.get(_get_controller(create=False).delete_deployment.remote(name),
+           timeout=60)
+
+
+def shutdown() -> None:
+    import ray_tpu as rt
+    try:
+        controller = _get_controller(create=False)
+    except ValueError:
+        return
+    try:
+        rt.get(controller.graceful_shutdown.remote(), timeout=60)
+    except Exception:
+        pass
+    try:
+        rt.kill(controller)
+    except Exception:
+        pass
+
+
+# Per-process batching state, keyed by a decoration-time uuid so the
+# wrapper stays picklable (locks/queues never enter the closure — a
+# deployment class containing a @batch method is cloudpickled to replicas).
+_batch_states: Dict[str, dict] = {}
+_batch_states_lock = threading.Lock()
+
+
+def _batch_state(key: str) -> dict:
+    with _batch_states_lock:
+        st = _batch_states.get(key)
+        if st is None:
+            st = _batch_states[key] = {"lock": threading.Lock(),
+                                       "pending": []}
+        return st
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Dynamic request batching (parity: serve/batching.py @serve.batch):
+    concurrent single calls coalesce into one list-call of the wrapped
+    function — the TPU path to batched jitted forwards."""
+    def wrap(fn):
+        import uuid
+        state_key = uuid.uuid4().hex
+
+        def flush():
+            st = _batch_state(state_key)
+            with st["lock"]:
+                batch_items = st["pending"][:]
+                st["pending"].clear()
+            if not batch_items:
+                return
+            items = [it[0] for it in batch_items]
+            self_obj = batch_items[0][2]
+            try:
+                outs = fn(self_obj, items) if self_obj is not None \
+                    else fn(items)
+                if len(outs) != len(items):
+                    raise ValueError(
+                        f"@serve.batch fn returned {len(outs)} results "
+                        f"for {len(items)} inputs")
+                for (_, slot, _), out in zip(batch_items, outs):
+                    slot["result"] = out
+                    slot["event"].set()
+            except BaseException as e:  # noqa: BLE001
+                for _, slot, _ in batch_items:
+                    slot["error"] = e
+                    slot["event"].set()
+
+        @functools.wraps(fn)
+        def wrapper(*call_args):
+            if len(call_args) == 2:
+                self_obj, item = call_args
+            else:
+                self_obj, item = None, call_args[0]
+            slot = {"event": threading.Event(), "result": None,
+                    "error": None}
+            st = _batch_state(state_key)
+            do_flush = False
+            with st["lock"]:
+                st["pending"].append((item, slot, self_obj))
+                if len(st["pending"]) >= max_batch_size:
+                    do_flush = True
+            if do_flush:
+                flush()
+            else:
+                threading.Timer(batch_wait_timeout_s, flush).start()
+            slot["event"].wait(timeout=120)
+            if slot["error"] is not None:
+                raise slot["error"]
+            return slot["result"]
+
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

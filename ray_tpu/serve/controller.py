@@ -1,0 +1,246 @@
+"""Serve control plane: controller + replica actors + router.
+
+Role parity: serve/controller.py:73 (ServeController reconcile loop),
+_private/deployment_state.py (target vs running replicas FSM),
+_private/replica.py (replica actor wrapping the user callable),
+_private/router.py:263 (queue-length-aware replica choice),
+_private/autoscaling_policy.py (replicas from in-flight load).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Replica:
+    """Actor wrapping one instance of the user's deployment callable."""
+
+    def __init__(self, cls_or_fn_blob: bytes, init_args_blob: bytes):
+        import cloudpickle
+        target = cloudpickle.loads(cls_or_fn_blob)
+        args, kwargs = cloudpickle.loads(init_args_blob)
+        if isinstance(target, type):
+            self.callable = target(*args, **kwargs)
+        else:
+            self.callable = target
+        self._inflight = 0
+
+    def handle_request(self, method: str, args_blob: bytes):
+        import cloudpickle
+        args, kwargs = cloudpickle.loads(args_blob)
+        self._inflight += 1
+        try:
+            fn = self.callable if method == "__call__" else \
+                getattr(self.callable, method)
+            if not callable(fn):
+                raise AttributeError(f"deployment has no method {method!r}")
+            out = fn(*args, **kwargs)
+            import inspect
+            if inspect.isawaitable(out):
+                # Replica methods run on pool threads (max_concurrency>1):
+                # drive the coroutine on a fresh loop, not a thread-global.
+                import asyncio
+                loop = asyncio.new_event_loop()
+                try:
+                    out = loop.run_until_complete(out)
+                finally:
+                    loop.close()
+            return out
+        finally:
+            self._inflight -= 1
+
+    def queue_len(self) -> int:
+        return self._inflight
+
+    def reconfigure(self, user_config) -> bool:
+        hook = getattr(self.callable, "reconfigure", None)
+        if hook is not None:
+            hook(user_config)
+        return True
+
+    def check_health(self) -> bool:
+        hook = getattr(self.callable, "check_health", None)
+        if hook is not None:
+            hook()
+        return True
+
+
+class ServeController:
+    """Singleton named actor reconciling deployment specs to replicas."""
+
+    CONTROLLER_NAME = "RTPU_SERVE_CONTROLLER"
+
+    def __init__(self, http_port: int = 0):
+        self.deployments: Dict[str, dict] = {}   # name -> spec
+        self.replicas: Dict[str, List[Any]] = {}  # name -> actor handles
+        self._lock = threading.Lock()
+        # serializes reconcile passes (deploy() and the loop both enter;
+        # the controller actor itself runs with max_concurrency > 1)
+        self._reconcile_lock = threading.Lock()
+        self._stopped = False
+        self.http_port = http_port
+        self.http_actor = None
+        self._reconciler = threading.Thread(target=self._reconcile_loop,
+                                            daemon=True)
+        self._reconciler.start()
+
+    # -- deployment management ------------------------------------------
+    def deploy(self, name: str, cls_blob: bytes, init_args_blob: bytes,
+               num_replicas: int, ray_actor_options: dict,
+               user_config=None, route_prefix: Optional[str] = None,
+               max_concurrent_queries: int = 100,
+               autoscaling: Optional[dict] = None) -> bool:
+        with self._lock:
+            self.deployments[name] = {
+                "name": name, "cls_blob": cls_blob,
+                "init_args_blob": init_args_blob,
+                "num_replicas": num_replicas,
+                "ray_actor_options": ray_actor_options or {},
+                "user_config": user_config,
+                "route_prefix": route_prefix,
+                "max_concurrent_queries": max_concurrent_queries,
+                "autoscaling": autoscaling,
+            }
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        import ray_tpu as rt
+        with self._lock:
+            self.deployments.pop(name, None)
+            dead = self.replicas.pop(name, [])
+        for a in dead:
+            try:
+                rt.kill(a)
+            except Exception:
+                pass
+        return True
+
+    def _spawn_replica(self, spec: dict):
+        import ray_tpu as rt
+        opts = dict(spec["ray_actor_options"])
+        cls = rt.remote(Replica)
+        handle = cls.options(
+            num_cpus=opts.get("num_cpus", 1),
+            num_tpus=opts.get("num_tpus", 0),
+            resources=opts.get("resources", {}),
+            max_concurrency=spec["max_concurrent_queries"],
+        ).remote(spec["cls_blob"], spec["init_args_blob"])
+        if spec.get("user_config") is not None:
+            rt.get(handle.reconfigure.remote(spec["user_config"]),
+                   timeout=120)
+        return handle
+
+    def _reconcile_once(self) -> None:
+        import ray_tpu as rt
+        with self._reconcile_lock:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self) -> None:
+        import ray_tpu as rt
+        with self._lock:
+            specs = dict(self.deployments)
+        for name, spec in specs.items():
+            current = self.replicas.setdefault(name, [])
+            # replace dead replicas (health check by ping)
+            alive = []
+            for a in current:
+                try:
+                    rt.get(a.check_health.remote(), timeout=10)
+                    alive.append(a)
+                except Exception:
+                    try:
+                        rt.kill(a)
+                    except Exception:
+                        pass
+            current[:] = alive
+            target = spec["num_replicas"]
+            while len(current) < target:
+                current.append(self._spawn_replica(spec))
+            import ray_tpu as rt2
+            while len(current) > target:
+                try:
+                    rt2.kill(current.pop())
+                except Exception:
+                    pass
+
+    def _reconcile_loop(self) -> None:
+        while not self._stopped:
+            time.sleep(2.0)
+            try:
+                self._reconcile_once()
+                self._autoscale()
+            except Exception:
+                pass
+
+    def _autoscale(self) -> None:
+        """Queue-length autoscaling (parity: autoscaling_policy.py — scale
+        to total_queue_len / target_ongoing_requests, clamped)."""
+        import ray_tpu as rt
+        with self._lock:
+            specs = dict(self.deployments)
+        for name, spec in specs.items():
+            cfg = spec.get("autoscaling")
+            if not cfg:
+                continue
+            replicas = self.replicas.get(name, [])
+            if not replicas:
+                continue
+            try:
+                qlens = rt.get([r.queue_len.remote() for r in replicas],
+                               timeout=15)
+            except Exception:
+                continue
+            target_ongoing = cfg.get("target_num_ongoing_requests", 2)
+            desired = max(cfg.get("min_replicas", 1),
+                          min(cfg.get("max_replicas", 10),
+                              -(-sum(qlens) // target_ongoing) or 1))
+            if desired != spec["num_replicas"]:
+                with self._lock:
+                    self.deployments[name]["num_replicas"] = desired
+
+    # -- routing ---------------------------------------------------------
+    def get_replicas(self, name: str) -> List[Any]:
+        return list(self.replicas.get(name, []))
+
+    def get_deployment_names(self) -> List[str]:
+        with self._lock:
+            return list(self.deployments)
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return {spec["route_prefix"] or f"/{name}": name
+                    for name, spec in self.deployments.items()}
+
+    def status(self) -> Dict[str, dict]:
+        with self._lock:
+            return {name: {
+                "num_replicas_target": spec["num_replicas"],
+                "num_replicas_running": len(self.replicas.get(name, [])),
+                "route_prefix": spec["route_prefix"],
+            } for name, spec in self.deployments.items()}
+
+    def start_http(self, host: str, port: int) -> int:
+        import ray_tpu as rt
+        from ray_tpu.serve.http_proxy import HTTPProxy
+        if self.http_actor is None:
+            cls = rt.remote(HTTPProxy)
+            self.http_actor = cls.options(
+                num_cpus=0.5, max_concurrency=64).remote(host, port)
+            self.http_port = rt.get(self.http_actor.port.remote(),
+                                    timeout=60)
+        return self.http_port
+
+    def graceful_shutdown(self) -> bool:
+        import ray_tpu as rt
+        self._stopped = True
+        for name in list(self.deployments):
+            self.delete_deployment(name)
+        if self.http_actor is not None:
+            try:
+                rt.kill(self.http_actor)
+            except Exception:
+                pass
+        return True

@@ -1,0 +1,92 @@
+"""HTTP ingress for serve deployments.
+
+Role parity: serve/_private/http_proxy.py:250 — per-node proxy actor
+translating HTTP to deployment calls. The reference runs uvicorn/starlette;
+here a stdlib ThreadingHTTPServer inside the proxy actor keeps the image
+dependency-free. Routes come from the controller's route table; bodies are
+JSON (dict -> kwargs) or raw bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self):
+                import ray_tpu as rt
+                from ray_tpu.serve.api import _handle_for
+                try:
+                    routes = proxy._routes()
+                    path = self.path.split("?")[0]
+                    name = None
+                    for prefix, dep in sorted(routes.items(),
+                                              key=lambda kv: -len(kv[0])):
+                        if path == prefix or path.startswith(
+                                prefix.rstrip("/") + "/"):
+                            name = dep
+                            break
+                    if name is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        self.wfile.write(b'{"error": "no matching route"}')
+                        return
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    args, kwargs = (), {}
+                    if body:
+                        try:
+                            payload = json.loads(body)
+                            if isinstance(payload, dict):
+                                kwargs = payload
+                            else:
+                                args = (payload,)
+                        except json.JSONDecodeError:
+                            args = (body,)
+                    handle = _handle_for(name)
+                    out = rt.get(handle.remote(*args, **kwargs),
+                                 timeout=120)
+                    data = json.dumps(out, default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(data)
+                except Exception as e:  # noqa: BLE001 - HTTP error surface
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(json.dumps(
+                        {"error": repr(e)}).encode())
+
+            do_GET = _dispatch
+            do_POST = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        self._routes_cache = {}
+        self._routes_ts = 0.0
+
+    def _routes(self):
+        import time
+        import ray_tpu as rt
+        from ray_tpu.serve.controller import ServeController
+        if time.monotonic() - self._routes_ts > 1.0:
+            controller = rt.get_actor(ServeController.CONTROLLER_NAME)
+            self._routes_cache = rt.get(controller.get_routes.remote(),
+                                        timeout=30)
+            self._routes_ts = time.monotonic()
+        return self._routes_cache
+
+    def port(self) -> int:
+        return self._port

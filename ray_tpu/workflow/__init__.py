@@ -1,0 +1,13 @@
+"""ray_tpu.workflow — durable DAG execution.
+
+Parity surface: reference python/ray/workflow (workflow_executor.py,
+workflow_state_from_storage.py): run a DAG of tasks where every step's
+result is checkpointed to storage; a crashed/resumed workflow skips
+completed steps and recomputes only the rest.
+"""
+
+from ray_tpu.workflow.execution import (delete, get_output, get_status,
+                                        list_all, resume, run, run_async)
+
+__all__ = ["run", "run_async", "resume", "get_output", "get_status",
+           "list_all", "delete"]

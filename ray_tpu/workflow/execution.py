@@ -1,0 +1,183 @@
+"""Durable DAG executor.
+
+Each DAG node becomes a *step* with a deterministic step-id (the node's
+position in a post-order walk + function name). Before running a step the
+executor checks storage; a hit short-circuits the whole subtree (parity:
+workflow_state_from_storage.py recovery semantics). Results persist as
+pickle files under <storage>/<workflow_id>/steps/.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.nodes import DAGNode, FunctionNode, InputNode
+
+_DEFAULT_STORAGE = os.path.join(tempfile.gettempdir(), "rtpu_workflows")
+_storage_root = os.environ.get("RTPU_WORKFLOW_STORAGE", _DEFAULT_STORAGE)
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage_root, workflow_id)
+
+
+def _step_path(workflow_id: str, step_id: str) -> str:
+    return os.path.join(_wf_dir(workflow_id), "steps", f"{step_id}.pkl")
+
+
+def _assign_step_ids(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic ids: post-order index + callable name."""
+    order: List[DAGNode] = []
+    seen = set()
+
+    def walk(node: DAGNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for child in node._children():
+            walk(child)
+        order.append(node)
+
+    walk(dag)
+    ids = {}
+    for i, node in enumerate(order):
+        name = ""
+        if isinstance(node, FunctionNode):
+            name = getattr(node._remote_fn, "__name__", "fn")
+        elif isinstance(node, InputNode):
+            name = "input"
+        ids[id(node)] = f"{i:04d}_{name}"
+    return ids
+
+
+def _execute_durable(node: DAGNode, workflow_id: str,
+                     step_ids: Dict[int, str], memo: Dict[int, Any],
+                     input_value) -> Any:
+    import ray_tpu as rt
+    from ray_tpu.core.refs import ObjectRef
+
+    key = id(node)
+    if key in memo:
+        return memo[key]
+    step_id = step_ids[key]
+    path = _step_path(workflow_id, step_id)
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            out = pickle.load(f)
+        memo[key] = out
+        return out
+    if isinstance(node, InputNode):
+        out = input_value
+    else:
+        def rv(v):
+            return _execute_durable(v, workflow_id, step_ids, memo,
+                                    input_value) if isinstance(v, DAGNode) \
+                else v
+        args = tuple(rv(a) for a in node._bound_args)
+        kwargs = {k: rv(v) for k, v in node._bound_kwargs.items()}
+        if isinstance(node, FunctionNode):
+            out = rt.get(node._remote_fn.remote(*args, **kwargs))
+        else:
+            raise TypeError(
+                f"workflow DAGs support function nodes and InputNode; got "
+                f"{type(node).__name__} (actor nodes are not durable)")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(out, f, protocol=5)
+    os.replace(tmp, path)  # atomic commit of the step checkpoint
+    memo[key] = out
+    return out
+
+
+def _set_status(workflow_id: str, status: str, dag_blob: Optional[bytes],
+                input_blob: Optional[bytes] = None) -> None:
+    d = _wf_dir(workflow_id)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "status"), "w") as f:
+        f.write(status)
+    if dag_blob is not None:
+        with open(os.path.join(d, "dag.pkl"), "wb") as f:
+            f.write(dag_blob)
+    if input_blob is not None:
+        with open(os.path.join(d, "input.pkl"), "wb") as f:
+            f.write(input_blob)
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        input_value: Any = None) -> Any:
+    """Execute a DAG durably; returns the final result."""
+    import uuid
+
+    import cloudpickle
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:8]}"
+    _set_status(workflow_id, "RUNNING", cloudpickle.dumps(dag),
+                cloudpickle.dumps(input_value))
+    step_ids = _assign_step_ids(dag)
+    try:
+        out = _execute_durable(dag, workflow_id, step_ids, {}, input_value)
+    except BaseException:
+        _set_status(workflow_id, "FAILED", None)
+        raise
+    with open(os.path.join(_wf_dir(workflow_id), "output.pkl"), "wb") as f:
+        pickle.dump(out, f, protocol=5)
+    _set_status(workflow_id, "SUCCESSFUL", None)
+    return out
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              input_value: Any = None):
+    """Returns a concurrent.futures.Future of run()."""
+    from concurrent.futures import Future
+    fut: Future = Future()
+
+    def go():
+        try:
+            fut.set_result(run(dag, workflow_id=workflow_id,
+                               input_value=input_value))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=go, daemon=True).start()
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a stored workflow; completed steps are read from storage."""
+    import cloudpickle
+    d = _wf_dir(workflow_id)
+    with open(os.path.join(d, "dag.pkl"), "rb") as f:
+        dag = cloudpickle.load(f)
+    input_value = None
+    input_path = os.path.join(d, "input.pkl")
+    if os.path.exists(input_path):
+        with open(input_path, "rb") as f:
+            input_value = cloudpickle.load(f)
+    return run(dag, workflow_id=workflow_id, input_value=input_value)
+
+
+def get_output(workflow_id: str) -> Any:
+    with open(os.path.join(_wf_dir(workflow_id), "output.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+def get_status(workflow_id: str) -> str:
+    path = os.path.join(_wf_dir(workflow_id), "status")
+    if not os.path.exists(path):
+        return "NOT_FOUND"
+    return open(path).read().strip()
+
+
+def list_all() -> List[tuple]:
+    if not os.path.isdir(_storage_root):
+        return []
+    return [(wf, get_status(wf)) for wf in sorted(os.listdir(_storage_root))]
+
+
+def delete(workflow_id: str) -> None:
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)

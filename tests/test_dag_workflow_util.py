@@ -1,0 +1,181 @@
+"""DAG graphs, durable workflows, multiprocessing Pool, ActorPool, Queue
+(parity: python/ray/dag tests, workflow/tests, util tests)."""
+
+import os
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
+
+
+def test_function_dag(cluster):
+    from ray_tpu.dag import InputNode
+
+    @rt.remote
+    def plus(a, b):
+        return a + b
+
+    @rt.remote
+    def times(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        dag = times.bind(plus.bind(inp, 2), 10)
+    assert dag.execute(1) == 30
+    assert dag.execute(5) == 70
+
+
+def test_shared_subgraph_runs_once(cluster):
+    from ray_tpu.dag import InputNode
+
+    @rt.remote
+    def bump(path, x):
+        with open(path, "a") as f:
+            f.write("x")
+        return x + 1
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    import tempfile
+    path = os.path.join(tempfile.mkdtemp(), "count")
+    with InputNode() as inp:
+        shared = bump.bind(path, inp)
+        dag = add.bind(shared, shared)   # diamond: shared runs once
+    assert dag.execute(1) == 4
+    assert open(path).read() == "x"
+
+
+def test_actor_dag(cluster):
+    from ray_tpu.dag import InputNode
+
+    @rt.remote
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    with InputNode() as inp:
+        node = Adder.bind(100)
+        dag = node.add.bind(inp)
+    assert dag.execute(5) == 105
+
+
+def test_workflow_durable_resume(cluster, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.workflow import execution
+    execution._storage_root = str(tmp_path)
+    from ray_tpu.dag import InputNode
+
+    marker = str(tmp_path / "exec_count")
+
+    @rt.remote
+    def record(x):
+        with open(marker, "a") as f:
+            f.write("r")
+        return x * 2
+
+    @rt.remote
+    def final(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = final.bind(record.bind(inp))
+
+    out = workflow.run(dag, workflow_id="wf-test", input_value=21)
+    assert out == 43
+    assert workflow.get_status("wf-test") == "SUCCESSFUL"
+    assert workflow.get_output("wf-test") == 43
+    # resume skips completed steps: record must NOT run again
+    out2 = workflow.resume("wf-test")
+    assert out2 == 43
+    assert open(marker).read() == "r"
+    assert ("wf-test", "SUCCESSFUL") in workflow.list_all()
+    workflow.delete("wf-test")
+    assert workflow.get_status("wf-test") == "NOT_FOUND"
+
+
+def test_workflow_failure_then_resume(cluster, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.workflow import execution
+    execution._storage_root = str(tmp_path)
+    from ray_tpu.dag import InputNode
+
+    flag = str(tmp_path / "ok")
+
+    @rt.remote
+    def stage1(x):
+        return x + 1
+
+    @rt.remote
+    def maybe_fail(x):
+        if not os.path.exists(flag):
+            raise RuntimeError("transient failure")
+        return x * 10
+
+    with InputNode() as inp:
+        dag = maybe_fail.bind(stage1.bind(inp))
+
+    with pytest.raises(rt.TaskError):
+        workflow.run(dag, workflow_id="wf-fail", input_value=4)
+    assert workflow.get_status("wf-fail") == "FAILED"
+    open(flag, "w").close()
+    assert workflow.resume("wf-fail") == 50
+    assert workflow.get_status("wf-fail") == "SUCCESSFUL"
+
+
+def test_multiprocessing_pool(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=3) as pool:
+        assert pool.map(lambda x: x * x, range(10)) == \
+            [x * x for x in range(10)]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        r = pool.apply_async(lambda a: a + 1, (41,))
+        assert r.get(timeout=60) == 42
+        assert list(pool.imap(str, [1, 2, 3])) == ["1", "2", "3"]
+
+
+def test_actor_pool(cluster):
+    from ray_tpu.util import ActorPool
+
+    @rt.remote
+    class Sq:
+        def f(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.f.remote(v), [1, 2, 3, 4]))
+    assert out == [1, 4, 9, 16]
+
+
+def test_distributed_queue(cluster):
+    from ray_tpu.util import Queue
+    from ray_tpu.util.queue import Empty
+
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get(block=False)
+    q.shutdown()
