@@ -66,10 +66,62 @@ class Checkpoint:
 
     def __reduce__(self):
         # dict checkpoints ship by value (out-of-band buffers keep arrays
-        # zero-copy); directory checkpoints ship by path.
+        # zero-copy); directory checkpoints pack their contents so the
+        # checkpoint survives crossing node boundaries (the reference
+        # Checkpoint packs directories for transport — a bare path would
+        # dangle on any other host).
+        if self._path is not None:
+            return (_unpack_dir_checkpoint, (_pack_dir(self._path),))
         return (Checkpoint, (self._data, self._path))
 
     def __repr__(self):
         if self._path:
             return f"Checkpoint(path={self._path!r})"
         return f"Checkpoint(dict with {len(self._data)} keys)"
+
+
+def _pack_dir(path: str) -> bytes:
+    """Tar a checkpoint directory into bytes for transport."""
+    import io
+    import tarfile
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        tar.add(path, arcname=".")
+    return buf.getvalue()
+
+
+_unpacked_dirs: Dict[str, str] = {}
+_unpacked_lock = None
+
+
+def _unpack_dir_checkpoint(payload: bytes) -> "Checkpoint":
+    """Restore a packed directory checkpoint into a local temp dir.
+
+    Deduped by content digest (a worker receiving the same checkpoint every
+    round extracts once) and removed at interpreter exit so repeated
+    deserialization cannot fill the disk with orphaned copies."""
+    import atexit
+    import hashlib
+    import io
+    import tarfile
+    import threading
+    global _unpacked_lock
+    if _unpacked_lock is None:
+        _unpacked_lock = threading.Lock()
+    digest = hashlib.blake2b(payload, digest_size=16).hexdigest()
+    with _unpacked_lock:
+        path = _unpacked_dirs.get(digest)
+        if path is not None and os.path.isdir(path):
+            return Checkpoint.from_directory(path)
+        path = tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        with tarfile.open(fileobj=io.BytesIO(payload), mode="r") as tar:
+            tar.extractall(path, filter="data")
+        if not _unpacked_dirs:
+            atexit.register(_cleanup_unpacked)
+        _unpacked_dirs[digest] = path
+    return Checkpoint.from_directory(path)
+
+
+def _cleanup_unpacked() -> None:
+    for path in _unpacked_dirs.values():
+        shutil.rmtree(path, ignore_errors=True)

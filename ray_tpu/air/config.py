@@ -28,6 +28,24 @@ class ScalingConfig:
     # Parallelism axes for the compiled step (dp=-1 -> infer remainder).
     mesh: Dict[str, int] = field(default_factory=dict)
 
+    def __post_init__(self):
+        if self.topology:
+            # topology="v4-8" makes the config slice-native: one worker per
+            # slice host, each reserving the host's chips, gang-placed with
+            # strategy SLICE (ICI contiguity).
+            from ray_tpu.tpu.topology import SliceSpec
+            spec = SliceSpec.parse(self.topology)
+            self.use_tpu = True
+            if self.num_workers <= 1:
+                self.num_workers = spec.num_hosts
+            elif self.num_workers != spec.num_hosts:
+                raise ValueError(
+                    f"topology {self.topology!r} has {spec.num_hosts} hosts "
+                    f"but num_workers={self.num_workers}; one train worker "
+                    f"per slice host is required for the pjit gang")
+            if not self.tpus_per_worker:
+                self.tpus_per_worker = float(spec.chips_per_host)
+
     def worker_resources(self) -> Dict[str, float]:
         res = {"CPU": float(self.cpus_per_worker)}
         if self.use_tpu or self.tpus_per_worker:
@@ -37,9 +55,14 @@ class ScalingConfig:
 
     def as_placement_group_factory(self):
         """One bundle per worker (parity: air/config.py
-        as_placement_group_factory -> PlacementGroupFactory)."""
+        as_placement_group_factory -> PlacementGroupFactory). With a
+        topology, the PG is slice-granular: bundle i lands on the slice's
+        rank-i host."""
         from ray_tpu.util.placement_group import placement_group
         bundles = [self.worker_resources() for _ in range(self.num_workers)]
+        if self.topology:
+            return lambda: placement_group(bundles, strategy="SLICE",
+                                           slice_topology=self.topology)
         return lambda: placement_group(bundles,
                                        strategy=self.placement_strategy)
 

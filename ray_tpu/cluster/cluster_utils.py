@@ -29,14 +29,18 @@ class Cluster:
     def add_node(self, num_cpus: float = 4.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
                  object_store_bytes: int = 256 << 20,
-                 is_head: bool = False) -> NodeDaemon:
+                 is_head: bool = False,
+                 tpu_slice: Optional[dict] = None) -> NodeDaemon:
+        """``tpu_slice`` injects fake slice membership (slice_id,
+        accelerator_type, generation, worker_id, num_hosts) — the test
+        analog of a real TPU host's env-derived topology.detect_slice()."""
         total = {"CPU": float(num_cpus)}
         if num_tpus:
             total["TPU"] = float(num_tpus)
         total.update(resources or {})
         node = NodeDaemon(self.address, resources=total,
                           object_store_bytes=object_store_bytes,
-                          is_head=is_head)
+                          is_head=is_head, tpu_slice=tpu_slice)
         self.nodes.append(node)
         return node
 

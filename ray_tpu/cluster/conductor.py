@@ -46,13 +46,17 @@ class ActorInfo:
 
 class PlacementGroupInfo:
     def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]],
-                 strategy: str, name: str):
+                 strategy: str, name: str, slice_topology: str = ""):
         self.pg_id = pg_id
         self.bundles = bundles
         self.strategy = strategy
         self.name = name
+        self.slice_topology = slice_topology  # SLICE strategy filter (v4-8)
+        self.slice_id: Optional[str] = None   # chosen slice once CREATED
         self.state = "PENDING"        # PENDING | CREATED | REMOVED
         self.bundle_nodes: List[Optional[bytes]] = [None] * len(bundles)
+        self.placing = False          # a 2PC attempt is in flight
+        self.retry_scheduled = False  # a retry Timer is pending
 
 
 class Conductor:
@@ -85,7 +89,8 @@ class Conductor:
     # ------------------------------------------------------------------
     def rpc_register_node(self, node_id: bytes, address: str,
                           resources: Dict[str, float], store_socket: str,
-                          is_head: bool = False) -> dict:
+                          is_head: bool = False,
+                          tpu_slice: Optional[dict] = None) -> dict:
         with self._cv:
             self._nodes[node_id] = {
                 "node_id": node_id,
@@ -94,11 +99,55 @@ class Conductor:
                 "resources_available": dict(resources),
                 "store_socket": store_socket,
                 "is_head": is_head,
+                "tpu_slice": dict(tpu_slice) if tpu_slice else None,
                 "alive": True,
                 "last_heartbeat": time.monotonic(),
             }
             self._cv.notify_all()
+        # A new slice host may complete a gang a pending slice PG waits on.
+        with self._lock:
+            pending = [pg for pg in self._pgs.values()
+                       if pg.state == "PENDING"]
+        for pg in pending:
+            self._try_place_pg(pg)
         return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # TPU slice view (the differentiator: ICI-contiguous gang placement;
+    # the reference's nearest analog is the PG scheduler's bundle packing,
+    # gcs_placement_group_scheduler.h:265, which has no topology notion)
+    # ------------------------------------------------------------------
+    def _slice_view(self) -> Dict[str, dict]:
+        """Group live TPU nodes by slice. Caller must hold self._lock."""
+        slices: Dict[str, dict] = {}
+        for info in self._nodes.values():
+            if not info["alive"] or not info.get("tpu_slice"):
+                continue
+            ts = info["tpu_slice"]
+            s = slices.setdefault(ts["slice_id"], {
+                "slice_id": ts["slice_id"],
+                "accelerator_type": ts["accelerator_type"],
+                "generation": ts["generation"],
+                "num_hosts": ts["num_hosts"],
+                "hosts": [],
+            })
+            s["hosts"].append(info)
+        for s in slices.values():
+            s["hosts"].sort(key=lambda n: n["tpu_slice"]["worker_id"])
+            s["complete"] = len(s["hosts"]) >= s["num_hosts"]
+        return slices
+
+    def rpc_get_slices(self) -> List[dict]:
+        with self._lock:
+            return [{
+                "slice_id": s["slice_id"],
+                "accelerator_type": s["accelerator_type"],
+                "generation": s["generation"],
+                "num_hosts": s["num_hosts"],
+                "registered_hosts": len(s["hosts"]),
+                "complete": s["complete"],
+                "node_ids": [n["node_id"] for n in s["hosts"]],
+            } for s in self._slice_view().values()]
 
     def rpc_heartbeat(self, node_id: bytes,
                       resources_available: Dict[str, float],
@@ -195,6 +244,7 @@ class Conductor:
             for pg in self._pgs.values():
                 if pg.state == "CREATED" and node_id in pg.bundle_nodes:
                     pg.state = "PENDING"
+                    pg.slice_id = None
                     pg.bundle_nodes = [
                         None if n == node_id else n for n in pg.bundle_nodes]
             self._cv.notify_all()
@@ -351,11 +401,25 @@ class Conductor:
                     return dict(info)
                 return None if not strategy.get("soft") else self._best_fit(
                     resources)
+            if isinstance(strategy, dict) and strategy.get("type") == "slice":
+                # Constrain the candidate set to hosts of complete slices
+                # matching the requested topology, then best-fit within it.
+                topo = strategy.get("topology") or ""
+                candidates: List[dict] = []
+                for s in self._slice_view().values():
+                    if not s["complete"]:
+                        continue
+                    if topo and s["accelerator_type"] != topo:
+                        continue
+                    candidates.extend(s["hosts"])
+                return self._best_fit(resources, candidates)
             return self._best_fit(resources)
 
-    def _best_fit(self, resources: Dict[str, float]) -> Optional[dict]:
+    def _best_fit(self, resources: Dict[str, float],
+                  candidates: Optional[List[dict]] = None) -> Optional[dict]:
         best, best_score = None, -1.0
-        for info in self._nodes.values():
+        pool = self._nodes.values() if candidates is None else candidates
+        for info in pool:
             if not info["alive"]:
                 continue
             avail = info["resources_available"]
@@ -525,55 +589,86 @@ class Conductor:
     # ------------------------------------------------------------------
     def rpc_create_placement_group(self, pg_id: bytes,
                                    bundles: List[Dict[str, float]],
-                                   strategy: str, name: str = "") -> None:
-        pg = PlacementGroupInfo(pg_id, bundles, strategy, name)
+                                   strategy: str, name: str = "",
+                                   slice_topology: str = "") -> None:
+        pg = PlacementGroupInfo(pg_id, bundles, strategy, name,
+                                slice_topology=slice_topology)
         with self._lock:
             self._pgs[pg_id] = pg
         self._try_place_pg(pg)
 
     def _try_place_pg(self, pg: PlacementGroupInfo) -> None:
         """Pick nodes per strategy, then 2PC: prepare on every node; commit
-        all on success, return-on-any-failure (retry later)."""
+        all on success, return-on-any-failure (retry later). Single-placer:
+        concurrent triggers (registration handlers, retry timers, node-death
+        replacement) collapse onto one in-flight attempt — two attempts
+        committing different plans would leak the losing plan's bundles."""
         with self._lock:
-            if pg.state != "PENDING":
+            if pg.state != "PENDING" or pg.placing:
                 return
+            pg.placing = True
             live = [dict(v) for v in self._nodes.values() if v["alive"]]
-        plan = self._plan_bundles(pg, live)
-        if plan is None:
-            threading.Timer(0.5, self._try_place_pg, args=(pg,)).start()
-            return
-        prepared: List[Tuple[bytes, str, int]] = []
-        ok = True
-        for idx, node in enumerate(plan):
-            try:
-                granted = get_client(node["address"]).call(
-                    "prepare_bundle", pg_id=pg.pg_id, bundle_index=idx,
-                    resources=pg.bundles[idx])
-            except Exception:
-                granted = False
-            if not granted:
-                ok = False
-                break
-            prepared.append((node["node_id"], node["address"], idx))
-        if not ok:
-            for _, addr, idx in prepared:
+        try:
+            plan = self._plan_bundles(pg, live)
+            if plan is None:
+                self._schedule_pg_retry(pg)
+                return
+            prepared: List[Tuple[bytes, str, int]] = []
+            ok = True
+            for idx, node in enumerate(plan):
                 try:
-                    get_client(addr).call("return_bundle", pg_id=pg.pg_id,
-                                          bundle_index=idx)
+                    granted = get_client(node["address"]).call(
+                        "prepare_bundle", pg_id=pg.pg_id, bundle_index=idx,
+                        resources=pg.bundles[idx])
                 except Exception:
-                    pass
-            threading.Timer(0.5, self._try_place_pg, args=(pg,)).start()
-            return
-        for _, addr, idx in prepared:
-            try:
-                get_client(addr).call("commit_bundle", pg_id=pg.pg_id,
-                                      bundle_index=idx)
-            except Exception:
-                pass
-        with self._cv:
-            pg.bundle_nodes = [n["node_id"] for n in plan]
-            pg.state = "CREATED"
-            self._cv.notify_all()
+                    granted = False
+                if not granted:
+                    ok = False
+                    break
+                prepared.append((node["node_id"], node["address"], idx))
+            with self._lock:
+                removed = pg.state == "REMOVED"
+            if ok and not removed:
+                for _, addr, idx in prepared:
+                    try:
+                        get_client(addr).call("commit_bundle", pg_id=pg.pg_id,
+                                              bundle_index=idx)
+                    except Exception:
+                        pass
+                with self._cv:
+                    if pg.state == "REMOVED":
+                        removed = True  # raced remove: roll back below
+                    else:
+                        pg.bundle_nodes = [n["node_id"] for n in plan]
+                        pg.state = "CREATED"
+                        self._cv.notify_all()
+            if not ok or removed:
+                for _, addr, idx in prepared:
+                    try:
+                        get_client(addr).call("return_bundle", pg_id=pg.pg_id,
+                                              bundle_index=idx)
+                    except Exception:
+                        pass
+                if not removed:
+                    self._schedule_pg_retry(pg)
+        finally:
+            with self._lock:
+                pg.placing = False
+
+    def _schedule_pg_retry(self, pg: PlacementGroupInfo) -> None:
+        """At most one pending retry timer per PG (triggers can arrive from
+        every node registration; unchecked they'd multiply timer chains)."""
+        with self._lock:
+            if pg.retry_scheduled or pg.state != "PENDING":
+                return
+            pg.retry_scheduled = True
+
+        def fire():
+            with self._lock:
+                pg.retry_scheduled = False
+            self._try_place_pg(pg)
+
+        threading.Timer(0.5, fire).start()
 
     def _plan_bundles(self, pg: PlacementGroupInfo,
                       live: List[dict]) -> Optional[List[dict]]:
@@ -591,6 +686,39 @@ class Conductor:
                 avail[nid][k] = avail[nid].get(k, 0.0) - v
 
         plan: List[dict] = []
+        if pg.strategy == "SLICE":
+            # ICI-contiguity: every bundle lands on hosts of ONE complete
+            # slice, bundle i on the slice's rank-i host (so jax process
+            # indices line up with TPU_WORKER_ID and collectives ride ICI).
+            # A request no single slice can hold is refused (stays PENDING)
+            # rather than silently spread across slices — stricter than the
+            # reference's STRICT_PACK (one *node*), which is the closest
+            # analog (gcs_placement_group_scheduler.h:265).
+            with self._lock:
+                slices = self._slice_view()
+            for s in sorted(slices.values(), key=lambda s: s["slice_id"]):
+                if not s["complete"]:
+                    continue
+                if pg.slice_topology and \
+                        s["accelerator_type"] != pg.slice_topology:
+                    continue
+                if len(pg.bundles) > len(s["hosts"]):
+                    continue
+                ok = True
+                for i, b in enumerate(pg.bundles):
+                    host = s["hosts"][i]
+                    if not fits(avail.get(host["node_id"], {}), b):
+                        ok = False
+                        break
+                    take(host["node_id"], b)
+                if ok:
+                    pg.slice_id = s["slice_id"]
+                    return [by_id[h["node_id"]] for h in
+                            s["hosts"][:len(pg.bundles)]]
+                # restore tentative takes before trying the next slice
+                avail.update({n["node_id"]: dict(n["resources_available"])
+                              for n in live})
+            return None
         if pg.strategy in ("STRICT_PACK", "PACK"):
             order = sorted(live, key=lambda n: -sum(
                 n["resources_available"].get(k, 0.0) for k in ("CPU", "TPU")))
@@ -642,11 +770,13 @@ class Conductor:
                     return {"state": "UNKNOWN"}
                 if pg.state == "CREATED" or timeout <= 0:
                     return {"state": pg.state,
-                            "bundle_nodes": list(pg.bundle_nodes)}
+                            "bundle_nodes": list(pg.bundle_nodes),
+                            "slice_id": pg.slice_id}
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return {"state": pg.state,
-                            "bundle_nodes": list(pg.bundle_nodes)}
+                            "bundle_nodes": list(pg.bundle_nodes),
+                            "slice_id": pg.slice_id}
                 self._cv.wait(min(remaining, 1.0))
 
     def rpc_remove_placement_group(self, pg_id: bytes) -> None:
@@ -669,6 +799,7 @@ class Conductor:
         with self._lock:
             return [{"pg_id": pg.pg_id.hex(), "state": pg.state,
                      "strategy": pg.strategy, "name": pg.name,
+                     "slice_id": pg.slice_id,
                      "bundles": pg.bundles} for pg in self._pgs.values()]
 
     # ------------------------------------------------------------------

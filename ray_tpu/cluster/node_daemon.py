@@ -54,7 +54,8 @@ class NodeDaemon:
                  object_store_bytes: int = 1 << 30,
                  is_head: bool = False,
                  session_dir: Optional[str] = None,
-                 env_vars: Optional[Dict[str, str]] = None):
+                 env_vars: Optional[Dict[str, str]] = None,
+                 tpu_slice: Optional[dict] = None):
         from ray_tpu.core.ids import NodeID
         self.node_id = NodeID.from_random().binary()
         self.conductor_address = conductor_address
@@ -63,6 +64,22 @@ class NodeDaemon:
         if resources is None:
             import multiprocessing
             resources = {"CPU": float(multiprocessing.cpu_count())}
+        resources = dict(resources)
+        # Slice membership: advertised to the conductor so slice-granular
+        # placement groups can demand ICI contiguity (SURVEY.md §7 phase 4).
+        if tpu_slice is None and resources.get("TPU", 0) > 0:
+            try:
+                from ray_tpu.tpu.topology import detect_slice
+                tpu_slice = detect_slice()
+            except Exception:
+                tpu_slice = None
+        self.tpu_slice = tpu_slice
+        if tpu_slice is not None:
+            # Typed per-generation resource next to the generic TPU count
+            # (lets tasks target a generation, tpu_resources() role). Added
+            # before total/_avail split so it is actually leasable.
+            gen_key = f"TPU-{tpu_slice['generation']}"
+            resources.setdefault(gen_key, resources.get("TPU", 0.0))
         self.total_resources = dict(resources)
         self._avail = dict(resources)
         self._lock = threading.Lock()
@@ -95,7 +112,7 @@ class NodeDaemon:
         get_client(conductor_address).call(
             "register_node", node_id=self.node_id, address=self.address,
             resources=self.total_resources, store_socket=self.store_socket,
-            is_head=is_head)
+            is_head=is_head, tpu_slice=self.tpu_slice)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True, name="daemon-hb")
         self._hb_thread.start()
@@ -141,6 +158,14 @@ class NodeDaemon:
         # plain task workers run on CPU unless the lease says otherwise.
         env.setdefault("JAX_PLATFORMS", env.get("RTPU_WORKER_JAX_PLATFORMS",
                                                 "cpu"))
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # CPU-only workers skip the TPU-plugin registration the image's
+            # sitecustomize performs at interpreter start (it imports jax,
+            # ~2s): spawn-to-register must stay well under the node reaper's
+            # dead-worker detection latency for lease grants to beat worker
+            # churn (worker_pool.h:156's prestart exists for the same
+            # reason).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         cwd = None
         if runtime_env and runtime_env.get("working_dir"):
             cwd = runtime_env["working_dir"]
@@ -197,16 +222,30 @@ class NodeDaemon:
                 from ray_tpu.cluster.protocol import drop_client
                 drop_client(w.address)
                 self._kill_worker(w)
-        w = self._spawn_worker(env_key, runtime_env)
-        if not w.registered.wait(timeout):
-            try:
-                w.proc.kill()
-            except OSError:
-                pass
+        # No reusable idle worker: spawn, and keep respawning within the
+        # deadline if a fresh worker dies before registering (under a chaos
+        # kill storm every starting process is a target; one attempt per
+        # lease would livelock the whole submitter).
+        deadline = time.monotonic() + timeout
+        while True:
+            w = self._spawn_worker(env_key, runtime_env)
+            while True:
+                if w.registered.wait(0.05):
+                    return w
+                if w.proc.poll() is not None:
+                    break  # died pre-registration; respawn below
+                if time.monotonic() >= deadline:
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+                    with self._lock:
+                        self._workers.pop(w.token, None)
+                    return None
             with self._lock:
                 self._workers.pop(w.token, None)
-            return None
-        return w
+            if time.monotonic() >= deadline:
+                return None
 
     def _checkin_worker(self, w: _Worker) -> None:
         with self._lock:
@@ -333,7 +372,7 @@ class NodeDaemon:
                 except ValueError:
                     pass
         env_key = self._env_key_of(runtime_env)
-        w = self._checkout_worker(env_key, runtime_env)
+        w = self._checkout_worker(env_key, runtime_env, timeout=10.0)
         if w is None:
             with self._cv:
                 _, _, give = self._resource_pool_for(strategy)

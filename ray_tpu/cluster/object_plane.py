@@ -57,7 +57,13 @@ class ObjectPlane:
         return hashlib.blake2b(oid.binary(), digest_size=16).digest()
 
     def contains(self, oid: ObjectID) -> bool:
-        return self.store.contains(self._key(oid))
+        try:
+            return self.store.contains(self._key(oid))
+        except (BrokenPipeError, ConnectionError, OSError):
+            # The store daemon is gone (runtime shutting down, or a chaos
+            # test killed it): "not present locally" is the right answer —
+            # readers fall back to the object directory / recovery.
+            return False
 
     def get_value(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
         view = self.get_view(oid, timeout=timeout)

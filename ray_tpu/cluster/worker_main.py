@@ -215,10 +215,16 @@ class WorkerService:
                            "actor_creation", start, time.time())
         return {"ok": True}
 
-    def _wait_turn(self, caller_id: bytes, seqno: int) -> None:
+    def _wait_turn(self, caller_id: bytes, seqno: int) -> bool:
+        """Block until this seqno's turn. Returns False for a duplicate:
+        a caller that lost the push reply resends the same seqno, which by
+        then has already executed (its returns are sealed in the store) —
+        re-executing would double-apply side effects and waiting would
+        deadlock (next_seq has moved past it)."""
         with self._seq_cv:
-            while self._next_seq.get(caller_id, 0) != seqno:
+            while self._next_seq.get(caller_id, 0) < seqno:
                 self._seq_cv.wait(1.0)
+            return self._next_seq.get(caller_id, 0) == seqno
 
     def _done_turn(self, caller_id: bytes, seqno: int) -> None:
         with self._seq_cv:
@@ -267,7 +273,8 @@ class WorkerService:
                     self._fail_returns(task_id, num_returns, e, name)
                 return err
 
-            self._wait_turn(caller_id, seqno)
+            if not self._wait_turn(caller_id, seqno):
+                return {"ok": True, "duplicate": True}
             asyncio.run_coroutine_threadsafe(run_async(), self.actor_loop)
             self._done_turn(caller_id, seqno)
             # Ack on enqueue: concurrent awaits must overlap, so completion
@@ -276,12 +283,14 @@ class WorkerService:
         elif self.actor_pool is not None:
             # max_concurrency > 1: out-of-order execution is allowed
             # (parity: out_of_order_actor_scheduling_queue.h).
-            self._wait_turn(caller_id, seqno)
+            if not self._wait_turn(caller_id, seqno):
+                return {"ok": True, "duplicate": True}
             self.actor_pool.submit(run_sync)
             self._done_turn(caller_id, seqno)
             return {"ok": True, "enqueued": True}
         else:
-            self._wait_turn(caller_id, seqno)
+            if not self._wait_turn(caller_id, seqno):
+                return {"ok": True, "duplicate": True}
             try:
                 error = run_sync()
             finally:

@@ -66,13 +66,14 @@ class _KeyState:
 
 
 class _TaskRecord:
-    __slots__ = ("task", "retries_left", "done", "cancelled")
+    __slots__ = ("task", "retries_left", "done", "cancelled", "submitted_at")
 
     def __init__(self, task: dict, retries_left: int):
         self.task = task
         self.retries_left = retries_left
         self.done = False
         self.cancelled = False
+        self.submitted_at = time.monotonic()
 
 
 class TaskSubmitter:
@@ -91,6 +92,7 @@ class TaskSubmitter:
                                               thread_name_prefix="lease")
         # lineage: return-oid -> _TaskRecord for reconstruction
         self._lineage: Dict[bytes, _TaskRecord] = {}
+        self._recover_lock = threading.Lock()
         # dependency gate (parity: raylet DependencyManager — a task only
         # takes a worker lease once its ObjectRef args exist somewhere, so
         # blocked consumers can never hold every worker while producers
@@ -114,9 +116,15 @@ class TaskSubmitter:
         for i in range(task["num_returns"]):
             oid = TaskID(task["task_id"]).object_id_for_return(i)
             self._lineage[oid.binary()] = rec
-            if len(self._lineage) > 20000:
-                # bounded lineage (parity: max_lineage_bytes budget)
-                self._lineage.pop(next(iter(self._lineage)))
+        if len(self._lineage) > 20000:
+            # Bounded lineage (parity: max_lineage_bytes budget) — but only
+            # completed records are evictable; records of tasks still in
+            # flight must survive or their objects become unrecoverable.
+            for k in list(self._lineage):
+                if self._lineage[k].done:
+                    del self._lineage[k]
+                    if len(self._lineage) <= 16000:
+                        break
         if task.get("deps"):
             with self._waiting_cv:
                 self._waiting.append(rec)
@@ -282,12 +290,44 @@ class TaskSubmitter:
         self.rt._release_lease(w)
 
     # -- lineage reconstruction (object_recovery_manager.h:106) --------
-    def try_recover(self, oid: ObjectID) -> bool:
-        rec = self._lineage.get(oid.binary())
-        if rec is None or not rec.done:
+    def try_recover(self, oid: ObjectID,
+                    _seen: Optional[set] = None) -> bool:
+        """Resubmit the task that produced ``oid``, recovering missing
+        dependencies transitively first (the reference reconstructs
+        recursively through lost lineage, object_recovery_manager.h:106).
+        Safe to call repeatedly: a record is only resubmitted from the
+        ``done`` state, and duplicate execution is idempotent because
+        returns are sealed-once in the store."""
+        if _seen is None:
+            _seen = set()
+        key = oid.binary()
+        if key in _seen:
+            return True
+        _seen.add(key)
+        rec = self._lineage.get(key)
+        if rec is None:
             return False
-        rec.done = False
-        rec.task = dict(rec.task)
+        with self._recover_lock:
+            if rec.cancelled:
+                return False
+            if not rec.done:
+                return True  # already queued / in flight
+            rec.done = False
+            rec.task = dict(rec.task)
+        # Recover lost deps first, or the dependency gate would block the
+        # resubmitted task forever.
+        deps = rec.task.get("deps") or []
+        dep_oids = rec.task.get("dep_oids") or []
+        if deps:
+            try:
+                exists = dict(zip(deps, self.rt.conductor.call(
+                    "objects_exist", oids=list(deps))))
+            except Exception:
+                exists = {}
+            for dkey, doid in zip(deps, dep_oids):
+                if not exists.get(dkey) and \
+                        not self.rt.plane.store.contains(dkey):
+                    self.try_recover(ObjectID(doid), _seen)
         self._enqueue(rec)
         return True
 
@@ -333,7 +373,18 @@ class _ActorClient:
                         self.rt._store_error_returns(t, self.death_error)
                     return
                 task = self.queue.popleft()
-            self._push_one(task)
+            try:
+                self._push_one(task)
+            except BaseException as e:  # noqa: BLE001 - must not kill pusher
+                # An unexpected error escaping _push_one would silently end
+                # this thread and strand every queued task; fail the task's
+                # refs instead and keep pumping.
+                try:
+                    self.rt._store_error_returns(
+                        task, TaskError.from_exception(
+                            e, f"{self.class_name}.{task['method_name']}"))
+                except Exception:
+                    pass
 
     def _resolve_address(self, timeout: float = 300.0) -> bool:
         info = self.rt.conductor.call("get_actor_info",
@@ -362,34 +413,46 @@ class _ActorClient:
             return False
         return False
 
-    def _push_one(self, task: dict, attempt: int = 0) -> None:
-        while self.address is None:
-            if not self._resolve_address():
-                if self.dead:
-                    self.rt._store_error_returns(task, self.death_error)
+    def _push_one(self, task: dict) -> None:
+        """Push with reference retry semantics: the sequence number commits
+        only after a successful push, so a retried push resends the SAME
+        seqno (the worker dedupes already-executed seqnos); a fresh
+        incarnation resets ordering via _resolve_address."""
+        attempt = 0
+        while True:
+            while self.address is None:
+                if not self._resolve_address():
+                    if self.dead:
+                        self.rt._store_error_returns(task, self.death_error)
+                        return
+                    continue
+            seq = self.seqno
+            try:
+                get_client(self.address).call(
+                    "push_actor_task", task_id=task["task_id"],
+                    caller_id=self.rt.caller_id, seqno=seq,
+                    method_name=task["method_name"],
+                    args_blob=task["args_blob"],
+                    num_returns=task["num_returns"])
+                self.seqno = seq + 1
+                return
+            except Exception:
+                # Any failure here is infrastructure (user exceptions are
+                # delivered via the object store, never raised through the
+                # push RPC): stale address, dying worker, or a restart race
+                # ("no actor hosted on this worker"). Re-resolve and retry
+                # within the task's budget.
+                self.address = None
+                attempt += 1
+                max_task_retries = task.get("max_task_retries", 0)
+                if max_task_retries == 0 or (
+                        0 < max_task_retries < attempt):
+                    self.rt._store_error_returns(
+                        task, TaskError.from_exception(
+                            ActorDiedError(self.class_name,
+                                           "actor worker unreachable"),
+                            f"{self.class_name}.{task['method_name']}"))
                     return
-                continue
-        seq = self.seqno
-        self.seqno += 1
-        try:
-            get_client(self.address).call(
-                "push_actor_task", task_id=task["task_id"],
-                caller_id=self.rt.caller_id, seqno=seq,
-                method_name=task["method_name"],
-                args_blob=task["args_blob"],
-                num_returns=task["num_returns"])
-        except (ConnectionLost, OSError, RpcError):
-            # Actor worker unreachable: consult the conductor FSM.
-            self.address = None
-            max_task_retries = task.get("max_task_retries", 0)
-            if max_task_retries != 0 and attempt < max(1, max_task_retries):
-                self._push_one(task, attempt + 1)
-            else:
-                self.rt._store_error_returns(
-                    task, TaskError.from_exception(
-                        ActorDiedError(self.class_name,
-                                       "actor worker unreachable"),
-                        f"{self.class_name}.{task['method_name']}"))
 
 
 class ClusterRuntime:
@@ -530,6 +593,24 @@ class ClusterRuntime:
                 targets.append(addr)
             if not addr and not strategy.get("soft"):
                 return None
+        elif isinstance(strategy, dict) and strategy.get("type") == "slice":
+            # Candidates are hosts of complete slices of the requested
+            # topology — never arbitrary nodes (a slice task must be able
+            # to reach its gang over ICI).
+            topo = strategy.get("topology") or ""
+            try:
+                slices = self.conductor.call("get_slices")
+            except Exception:
+                slices = []
+            wanted = {nid for s in slices
+                      if s["complete"] and
+                      (not topo or s["accelerator_type"] == topo)
+                      for nid in s["node_ids"]}
+            for n in self.conductor.call("get_nodes"):
+                if n["alive"] and n["node_id"] in wanted:
+                    targets.append(n["address"])
+            if not targets:
+                return None
         if not targets:
             targets = [self.daemon_address]
             nodes = sorted(
@@ -540,10 +621,15 @@ class ClusterRuntime:
             targets += [n["address"] for n in nodes]
         for addr in targets:
             try:
+                # _timeout bounds the client read: a daemon stuck spawning
+                # workers (e.g. under a kill storm) must not pin this lease
+                # thread forever — wait_timeout covers the resource wait and
+                # the daemon's 10s worker-checkout budget rides on top.
+                wait = 1.0 if addr == targets[-1] else 0.3
                 resp = get_client(addr).call(
                     "request_lease", resources=resources,
                     runtime_env=runtime_env, strategy=strategy,
-                    wait_timeout=1.0 if addr == targets[-1] else 0.3)
+                    wait_timeout=wait, _timeout=wait + 15.0)
             except Exception:
                 continue
             if resp.get("granted"):
@@ -581,13 +667,32 @@ class ClusterRuntime:
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
-        out = []
-        for ref in refs:
-            out.append(self._get_one(ref, deadline))
-        return out
+        if len(refs) <= 1:
+            return [self._get_one(ref, deadline) for ref in refs]
+        # Resolve concurrently: N remote objects fetch in parallel (the
+        # reference's Get batches plasma fetches the same way) and a lost
+        # object's recovery clock starts immediately instead of after its
+        # predecessors resolve.
+        with ThreadPoolExecutor(
+                max_workers=min(16, len(refs)),
+                thread_name_prefix="get") as pool:
+            futs = [pool.submit(self._get_one, ref, deadline) for ref in refs]
+            # Surface the first error in submission order (reference
+            # behavior), but let every future settle first so the pool
+            # doesn't leak workers into shutdown.
+            results, first_exc = [], None
+            for f in futs:
+                try:
+                    results.append(f.result())
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    if first_exc is None:
+                        first_exc = e
+                    results.append(None)
+            if first_exc is not None:
+                raise first_exc
+            return results
 
     def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
-        recover_attempted = False
         waited = 0.0
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
@@ -611,8 +716,11 @@ class ClusterRuntime:
                         raise TaskError.from_exception(
                             ActorDiedError(info.get("class_name", ""),
                                            info.get("death_reason", "")))
-                elif waited >= 4.0 and not recover_attempted:
-                    recover_attempted = True
+                elif waited >= 4.0:
+                    # Retry recovery on EVERY stall iteration, not once:
+                    # a reconstruction attempt can itself be lost to the
+                    # same fault that lost the object (the reference's
+                    # recovery manager re-enters on each failed Get).
                     self.submitter.try_recover(ref.id)
                 continue
             if isinstance(value, TaskError):
@@ -624,10 +732,26 @@ class ClusterRuntime:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
+        last_directory = 0.0
         while True:
             still = []
+            directory: dict = {}
+            now = time.monotonic()
+            if pending and now - last_directory >= 0.05:
+                # The local store only sees objects produced or pulled here;
+                # on a multi-node cluster readiness comes from the object
+                # directory (reference: ray.wait resolves via locations).
+                last_directory = now
+                keys = [self.plane._key(r.id) for r in pending]
+                try:
+                    directory = dict(zip(keys, self.conductor.call(
+                        "objects_exist", oids=keys)))
+                except Exception:
+                    directory = {}
             for r in pending:
-                if len(ready) < num_returns and self.plane.contains(r.id):
+                if len(ready) < num_returns and (
+                        self.plane.contains(r.id) or
+                        directory.get(self.plane._key(r.id))):
                     ready.append(r)
                 else:
                     still.append(r)
@@ -655,6 +779,17 @@ class ClusterRuntime:
             return None
         if isinstance(strategy, dict):
             return strategy
+        # SliceSchedulingStrategy: pin to one ICI slice; with an explicit
+        # backing placement group it degrades to the PG path (the PG itself
+        # was slice-placed), otherwise the conductor constrains candidates
+        # to complete-slice hosts ({"type": "slice"}).
+        if hasattr(strategy, "topology"):
+            pg = getattr(strategy, "placement_group", None)
+            if pg is not None:
+                return {"type": "pg", "pg_id": pg.id.binary(),
+                        "bundle_index": getattr(
+                            strategy, "placement_group_bundle_index", 0) or 0}
+            return {"type": "slice", "topology": strategy.topology}
         # PlacementGroupSchedulingStrategy / NodeAffinitySchedulingStrategy
         if hasattr(strategy, "placement_group"):
             pg = strategy.placement_group
@@ -681,9 +816,10 @@ class ClusterRuntime:
         # inside containers are passed through as refs (Ray semantics) and
         # must NOT block dispatch — a monitor handed a list of in-progress
         # refs has to start immediately.
-        deps = [self.plane._key(a.id)
-                for a in list(args) + list(kwargs.values())
-                if isinstance(a, ObjectRef)]
+        dep_refs = [a for a in list(args) + list(kwargs.values())
+                    if isinstance(a, ObjectRef)]
+        deps = [self.plane._key(a.id) for a in dep_refs]
+        dep_oids = [a.id.binary() for a in dep_refs]
         resources = {"CPU": opts.num_cpus, "TPU": opts.num_tpus,
                      **opts.resources}
         resources = {k: v for k, v in resources.items() if v > 0}
@@ -703,6 +839,7 @@ class ClusterRuntime:
             "name": opts.name or desc.repr_name(),
             "max_retries": max_retries,
             "deps": deps,
+            "dep_oids": dep_oids,
             "key": (desc.function_id, tuple(sorted(resources.items())),
                     repr(strategy), repr(opts.runtime_env)),
         }
@@ -840,9 +977,11 @@ class ClusterRuntime:
     # ------------------------------------------------------------------
     def create_placement_group(self, pg_id: bytes,
                                bundles: List[Dict[str, float]],
-                               strategy: str, name: str = "") -> None:
+                               strategy: str, name: str = "",
+                               slice_topology: str = "") -> None:
         self.conductor.call("create_placement_group", pg_id=pg_id,
-                            bundles=bundles, strategy=strategy, name=name)
+                            bundles=bundles, strategy=strategy, name=name,
+                            slice_topology=slice_topology)
 
     def pg_ready(self, pg_id: bytes, timeout: float = 0.0) -> dict:
         return self.conductor.call("pg_ready", pg_id=pg_id, timeout=timeout)

@@ -118,10 +118,23 @@ def _sort_block_task(block: Block, key: str, descending: bool) -> Block:
     return block.take(idx)
 
 
+def _stable_hash(k: Any) -> int:
+    """Process-stable partition hash: builtin hash() of str/bytes is
+    randomized per interpreter (PYTHONHASHSEED), and blocks of one groupby
+    are partitioned in different worker processes — an unstable hash would
+    scatter equal keys across partitions and return duplicate groups."""
+    import zlib
+    if isinstance(k, bytes):
+        return zlib.crc32(k)
+    if isinstance(k, (int, np.integer)):
+        return int(k) & 0xFFFFFFFF
+    return zlib.crc32(str(k).encode("utf-8", "surrogatepass"))
+
+
 def _groupby_partition_task(block: Block, key: str, n_out: int) -> List[Block]:
     acc = BlockAccessor(block)
     keys = acc.to_numpy([key])[key]
-    hashes = np.array([hash(k) % n_out for k in keys])
+    hashes = np.array([_stable_hash(k) % n_out for k in keys])
     return [acc.take_indices(np.nonzero(hashes == i)[0])
             for i in range(n_out)]
 

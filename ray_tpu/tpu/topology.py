@@ -163,6 +163,50 @@ def detect_topology() -> TpuTopology:
     )
 
 
+def detect_slice() -> Optional[dict]:
+    """Discover this host's TPU-slice membership for the scheduler.
+
+    The slice is the gang-scheduling unit (an ICI-connected chip set one
+    XLA program addresses); the node daemon advertises this dict at
+    registration so the conductor can place slice-granular placement
+    groups with ICI contiguity (parity role: the GPU/accelerator fields of
+    the reference's node resource spec, python/ray/_private/
+    resource_spec.py, extended with the slice identity Ray lacks).
+
+    On Cloud TPU VMs the runtime exposes TPU_ACCELERATOR_TYPE /
+    TPU_WORKER_ID / TPU_WORKER_HOSTNAMES; MEGASCALE_SLICE_ID appears on
+    multislice. Returns None off-TPU (callers may inject a fake slice for
+    tests).
+    """
+    at = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    hostnames = [h for h in os.environ.get(
+        "TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if not at:
+        try:
+            topo = detect_topology()
+        except Exception:
+            return None
+        if topo.platform != "tpu":
+            return None
+        at = topo.slice_spec.accelerator_type
+    try:
+        spec = SliceSpec.parse(at)
+    except ValueError:
+        return None
+    num_hosts = len(hostnames) or spec.num_hosts
+    slice_id = (os.environ.get("MEGASCALE_SLICE_ID")
+                or os.environ.get("TPU_NAME")
+                or ",".join(hostnames)
+                or f"local-{at}")
+    return {
+        "slice_id": slice_id,
+        "accelerator_type": at,
+        "generation": spec.generation,
+        "worker_id": int(os.environ.get("TPU_WORKER_ID", "0") or 0),
+        "num_hosts": num_hosts,
+    }
+
+
 def tpu_resources() -> Dict[str, float]:
     """Resource dict a node daemon advertises for its local chips.
 

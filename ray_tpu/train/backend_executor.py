@@ -78,17 +78,19 @@ class TrainingFailedError(RuntimeError):
 class BackendExecutor:
     def __init__(self, backend: Backend, num_workers: int,
                  resources_per_worker: Dict[str, float],
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK",
+                 slice_topology: str = ""):
         self.backend = backend
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker
         self.placement_strategy = placement_strategy
+        self.slice_topology = slice_topology
         self.worker_group: Optional[WorkerGroup] = None
 
     def start(self) -> None:
         self.worker_group = WorkerGroup(
             self.num_workers, self.resources_per_worker,
-            self.placement_strategy)
+            self.placement_strategy, slice_topology=self.slice_topology)
         self.backend.on_start(self.worker_group)
 
     def run(self, train_loop: Callable, config: dict,

@@ -114,14 +114,20 @@ class WorkerGroup:
     """Driver-side handle over the gang (parity: worker_group.py:92)."""
 
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK", slice_topology: str = ""):
         import ray_tpu as rt
         from ray_tpu.util.placement_group import placement_group
         from ray_tpu.util.scheduling_strategies import (
             PlacementGroupSchedulingStrategy)
         self.num_workers = num_workers
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
-        self.pg = placement_group(bundles, strategy=placement_strategy)
+        if slice_topology:
+            # Slice-gang: bundle i -> rank-i host of ONE ICI slice, so the
+            # jax.distributed process group matches TPU_WORKER_ID order.
+            self.pg = placement_group(bundles, strategy="SLICE",
+                                      slice_topology=slice_topology)
+        else:
+            self.pg = placement_group(bundles, strategy=placement_strategy)
         self.pg.ready(timeout=120)
         cls = rt.remote(RayTrainWorker)
         self.workers = []

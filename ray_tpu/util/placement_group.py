@@ -14,17 +14,19 @@ from typing import Dict, List, Optional
 from ray_tpu.core.exceptions import GetTimeoutError
 from ray_tpu.core.ids import PlacementGroupID
 
-VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+                    "SLICE")
 
 
 class PlacementGroup:
     def __init__(self, pg_id: PlacementGroupID,
                  bundles: List[Dict[str, float]], strategy: str,
-                 name: str = ""):
+                 name: str = "", slice_topology: str = ""):
         self.id = pg_id
         self.bundle_specs = bundles
         self.strategy = strategy
         self.name = name
+        self.slice_topology = slice_topology
 
     def ready(self, timeout: Optional[float] = None):
         """Block until all bundles are reserved; returns self (the reference
@@ -56,12 +58,17 @@ class PlacementGroup:
 
     def __reduce__(self):
         return (PlacementGroup, (self.id, self.bundle_specs, self.strategy,
-                                 self.name))
+                                 self.name, self.slice_topology))
 
 
 def placement_group(bundles: List[Dict[str, float]],
                     strategy: str = "PACK", name: str = "",
-                    lifetime: Optional[str] = None) -> PlacementGroup:
+                    lifetime: Optional[str] = None,
+                    slice_topology: str = "") -> PlacementGroup:
+    """Reserve bundles across the cluster. strategy="SLICE" gang-places all
+    bundles on the hosts of ONE ICI-connected TPU slice (bundle i on the
+    slice's rank-i host); ``slice_topology`` ("v4-8") restricts which
+    slices qualify."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"Invalid strategy {strategy!r}; "
                          f"one of {VALID_STRATEGIES}")
@@ -74,8 +81,9 @@ def placement_group(bundles: List[Dict[str, float]],
     from ray_tpu.core.api import _global_runtime
     rt = _global_runtime()
     pg_id = PlacementGroupID.from_random()
-    rt.create_placement_group(pg_id.binary(), bundles, strategy, name)
-    return PlacementGroup(pg_id, bundles, strategy, name)
+    rt.create_placement_group(pg_id.binary(), bundles, strategy, name,
+                              slice_topology=slice_topology)
+    return PlacementGroup(pg_id, bundles, strategy, name, slice_topology)
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:

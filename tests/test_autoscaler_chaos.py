@@ -140,6 +140,37 @@ def test_chaos_worker_killing_with_retries(cluster):
         t.join()
 
 
+def test_chaos_node_kill_lineage_reconstruction():
+    """Objects lost with a crashed NODE (store and all) are reconstructed
+    by re-running their generating tasks on a replacement node (parity:
+    object_recovery_manager.h:106 + test_chaos.py node-killer tests)."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    try:
+        n2 = c.add_node(num_cpus=4)
+        c.wait_for_nodes(2)
+
+        @rt.remote(max_retries=-1)
+        def produce(i):
+            return i * 2
+
+        refs = [produce.remote(i) for i in range(8)]
+        ready, _ = rt.wait(refs, num_returns=8, timeout=60)
+        assert len(ready) == 8
+        # Crash the only compute node: every produced object dies with its
+        # shm store. A replacement node joins; get() must trigger lineage
+        # reconstruction there.
+        c.remove_node(n2, graceful=False)
+        c.add_node(num_cpus=4)
+        out = rt.get(refs, timeout=90)
+        assert out == [i * 2 for i in range(8)]
+    finally:
+        core_api._runtime = None
+        rt_.shutdown()
+        c.shutdown()
+
+
 def test_runtime_env_env_vars(cluster):
     from ray_tpu.runtime_env import RuntimeEnv
 

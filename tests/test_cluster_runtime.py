@@ -158,14 +158,19 @@ def test_actor_restart(cluster):
             self.n += 1
             return self.n
 
-        def die(self):
+        def getpid(self):
             import os
-            os._exit(1)
+            return os.getpid()
 
+    # Reference pattern (test_actor_failures.py:155): the actor process is
+    # killed EXTERNALLY; with max_task_retries=-1 in-flight idempotent calls
+    # retry onto the restarted incarnation.
+    import os
+    import signal
     p = Phoenix.remote()
     assert rt.get(p.inc.remote()) == 1
-    p.die.remote()
-    time.sleep(1.0)
+    pid = rt.get(p.getpid.remote())
+    os.kill(pid, signal.SIGKILL)
     # After restart state resets; calls work again.
     deadline = time.time() + 30
     while True:

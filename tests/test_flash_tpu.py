@@ -1,0 +1,116 @@
+"""On-chip Pallas flash-attention verification (real TPU only).
+
+The regular suite pins JAX to a virtual CPU platform (conftest.py), so the
+Pallas kernel is exercised here only when run standalone on TPU hardware:
+
+    RTPU_TPU_TESTS=1 python -m pytest tests/test_flash_tpu.py --no-header \
+        -p no:cacheprovider -q   # WITHOUT the conftest CPU pin: run from a
+                                 # checkout where JAX sees the chip
+
+or via the driver's bench run (bench.py uses attn_impl="auto" -> flash).
+
+Tolerances are calibrated against a highest-precision gold: on TPU the
+default-precision XLA reference itself deviates ~4e-3 from that gold, so
+flash must stay within 2x of the reference's own deviation — checking
+flash directly against the default-precision reference would conflate MXU
+rounding with kernel bugs.
+
+Measured on v5e (2026-07, axon tunnel): fwd 67 TF/s at S=16k bq=512
+bk=2048; fwd+bwd 53 TF/s causal-equivalent at S=8192; flash beats the XLA
+reference 2.2x at S=8192.
+"""
+
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not (_on_tpu() or os.environ.get("RTPU_TPU_TESTS")),
+    reason="requires real TPU (run standalone without the CPU conftest pin)")
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="requires real TPU")
+def test_flash_fwd_bwd_matches_reference_on_chip():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.ops.attention import attention_reference
+    from ray_tpu.ops.flash import _pallas_supported, flash_attention
+
+    assert _pallas_supported()
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 512, 8, 128
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32) * 0.5
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32) * 0.5
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32) * 0.5
+
+    for causal in (True, False):
+        with jax.default_matmul_precision("highest"):
+            gold = jax.jit(
+                lambda q, k, v: attention_reference(q, k, v, causal=causal)
+            )(q, k, v)
+        ref = jax.jit(
+            lambda q, k, v: attention_reference(q, k, v, causal=causal)
+        )(q, k, v)
+        fl = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=causal)
+        )(q, k, v)
+        e_ref = float(jnp.max(jnp.abs(ref - gold)))
+        e_fl = float(jnp.max(jnp.abs(fl - gold)))
+        assert e_fl < max(2 * e_ref, 1e-4), (causal, e_fl, e_ref)
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        with jax.default_matmul_precision("highest"):
+            g_gold = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(q, k, v)
+        g_fl = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))(q, k, v)
+        for name, a, b, g in zip("qkv", g_fl, g_ref, g_gold):
+            sc = float(jnp.max(jnp.abs(g))) + 1e-9
+            e_r = float(jnp.max(jnp.abs(b - g))) / sc
+            e_f = float(jnp.max(jnp.abs(a - g))) / sc
+            assert e_f < max(2 * e_r, 1e-4), (causal, name, e_f, e_r)
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="requires real TPU")
+def test_flash_gqa_and_bf16_on_chip():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.ops.attention import attention_reference
+    from ray_tpu.ops.flash import flash_attention
+
+    rng = np.random.default_rng(1)
+    B, S, H, D = 2, 512, 8, 128
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32) * 0.5
+    kg = jnp.asarray(rng.normal(size=(B, S, H // 2, D)), jnp.float32)
+    vg = jnp.asarray(rng.normal(size=(B, S, H // 2, D)), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        gold = jax.jit(lambda q, k, v: attention_reference(q, k, v))(q, kg, vg)
+    fl = jax.jit(lambda q, k, v: flash_attention(q, k, v))(q, kg, vg)
+    ref = jax.jit(lambda q, k, v: attention_reference(q, k, v))(q, kg, vg)
+    e_f = float(jnp.max(jnp.abs(fl - gold)))
+    e_r = float(jnp.max(jnp.abs(ref - gold)))
+    assert e_f < max(2 * e_r, 1e-4)
+
+    qb, kb, vb = (x.astype(jnp.bfloat16)
+                  for x in (q, jnp.repeat(kg, 2, 2), jnp.repeat(vg, 2, 2)))
+    fl = jax.jit(lambda q, k, v: flash_attention(q, k, v))(qb, kb, vb)
+    rf = jax.jit(lambda q, k, v: attention_reference(q, k, v))(qb, kb, vb)
+    err = float(jnp.max(jnp.abs(
+        fl.astype(jnp.float32) - rf.astype(jnp.float32))))
+    assert err < 3e-2

@@ -1,0 +1,168 @@
+"""TPU-slice-aware scheduling tests.
+
+The differentiator vs the reference: slice-granular placement groups with
+ICI contiguity (all bundles on the hosts of ONE slice, bundle i on the
+rank-i host), vs the reference PG scheduler's topology-blind bundle packing
+(gcs_placement_group_scheduler.h:265). Fake hosts advertise slice
+membership the way a real TPU VM would via topology.detect_slice().
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.cluster.protocol import get_client
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+from ray_tpu.util.placement_group import (placement_group,
+                                          remove_placement_group)
+from ray_tpu.util.scheduling_strategies import SliceSchedulingStrategy
+
+
+def _slice(slice_id, worker_id, num_hosts=2, at="v4-8", gen="v4"):
+    return {"slice_id": slice_id, "accelerator_type": at,
+            "generation": gen, "worker_id": worker_id,
+            "num_hosts": num_hosts}
+
+
+@pytest.fixture()
+def slice_cluster():
+    """Head (driver, CPU-only) + two complete 2-host v4-8 slices."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    node_by_slice = {}
+    for sid in ("sliceA", "sliceB"):
+        node_by_slice[sid] = [
+            c.add_node(num_cpus=4, num_tpus=4,
+                       tpu_slice=_slice(sid, i)) for i in range(2)]
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    c.wait_for_nodes(5)
+    yield c, rt_, node_by_slice
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
+
+
+def _slice_of(node_by_slice, node_id):
+    for sid, nodes in node_by_slice.items():
+        if any(n.node_id == node_id for n in nodes):
+            return sid
+    return None
+
+
+def test_conductor_slice_view(slice_cluster):
+    c, rt_, _ = slice_cluster
+    slices = get_client(c.address).call("get_slices")
+    assert {s["slice_id"] for s in slices} == {"sliceA", "sliceB"}
+    for s in slices:
+        assert s["complete"] and s["registered_hosts"] == 2
+        assert s["accelerator_type"] == "v4-8"
+
+
+def test_slice_pg_lands_on_one_slice_rank_ordered(slice_cluster):
+    c, rt_, node_by_slice = slice_cluster
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SLICE",
+                         slice_topology="v4-8")
+    pg.ready(timeout=30)
+    info = rt_.pg_ready(pg.id.binary())
+    sids = {_slice_of(node_by_slice, n) for n in info["bundle_nodes"]}
+    assert len(sids) == 1, f"gang spans slices: {sids}"
+    assert info["slice_id"] in ("sliceA", "sliceB")
+    # bundle i -> the slice's rank-i host (worker_id order)
+    chosen = node_by_slice[sids.pop()]
+    assert info["bundle_nodes"] == [n.node_id for n in chosen]
+    remove_placement_group(pg)
+
+
+def test_slice_pg_queues_until_slice_frees(slice_cluster):
+    c, rt_, node_by_slice = slice_cluster
+    pgs = [placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SLICE")
+           for _ in range(2)]
+    for pg in pgs:
+        pg.ready(timeout=30)
+    infos = [rt_.pg_ready(pg.id.binary()) for pg in pgs]
+    assert {i["slice_id"] for i in infos} == {"sliceA", "sliceB"}
+    # Both slices full: a third gang must queue, not spread across slices.
+    pg3 = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SLICE")
+    assert not pg3.wait(timeout_seconds=2.0)
+    assert rt_.pg_ready(pg3.id.binary())["state"] == "PENDING"
+    # Freeing one slice unblocks it.
+    remove_placement_group(pgs[0])
+    pg3.ready(timeout=30)
+    assert rt_.pg_ready(pg3.id.binary())["state"] == "CREATED"
+    for pg in (pgs[1], pg3):
+        remove_placement_group(pg)
+
+
+def test_slice_pg_refuses_infeasible_topology(slice_cluster):
+    c, rt_, _ = slice_cluster
+    # No v5e-16 slice exists; the request must stay PENDING (refused),
+    # never satisfied by packing onto v4 hosts.
+    pg = placement_group([{"TPU": 4}], strategy="SLICE",
+                         slice_topology="v5e-16")
+    assert not pg.wait(timeout_seconds=2.0)
+    assert rt_.pg_ready(pg.id.binary())["state"] == "PENDING"
+    remove_placement_group(pg)
+    # Likewise a gang larger than any one slice (3 bundles, 2-host slices).
+    pg = placement_group([{"TPU": 4}] * 3, strategy="SLICE",
+                         slice_topology="v4-8")
+    assert not pg.wait(timeout_seconds=2.0)
+    assert rt_.pg_ready(pg.id.binary())["state"] == "PENDING"
+    remove_placement_group(pg)
+
+
+def test_slice_scheduling_strategy_task(slice_cluster):
+    c, rt_, node_by_slice = slice_cluster
+    slice_node_ids = {n.node_id.hex() for nodes in node_by_slice.values()
+                      for n in nodes}
+
+    # Identify placement via the conductor's resource bookkeeping: run
+    # tasks and check they consumed TPU on slice hosts only.
+    @rt.remote(num_tpus=1,
+               scheduling_strategy=SliceSchedulingStrategy(topology="v4-8"))
+    def occupy(t):
+        time.sleep(t)
+        return 1
+
+    refs = [occupy.remote(1.0) for _ in range(4)]
+    deadline = time.time() + 10
+    used_on_slice = False
+    while time.time() < deadline:
+        for n in rt.nodes():
+            if n["NodeID"] in slice_node_ids:
+                total = n["Resources"].get("TPU", 0.0)
+                avail = n["Available"].get("TPU", total)
+                if avail < total:
+                    used_on_slice = True
+        if used_on_slice:
+            break
+        time.sleep(0.1)
+    assert rt.get(refs, timeout=60) == [1] * 4
+    assert used_on_slice
+
+
+def test_slice_strategy_no_matching_slice_queues():
+    """With no slices registered at all, a slice-strategy task waits (and
+    completes once a matching slice joins)."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    try:
+        @rt.remote(num_tpus=1, scheduling_strategy=SliceSchedulingStrategy(
+            topology="v4-8"))
+        def f():
+            return 42
+
+        ref = f.remote()
+        ready, pending = rt.wait([ref], timeout=1.5)
+        assert not ready  # queued: no slice to run on
+        for i in range(2):
+            c.add_node(num_cpus=4, num_tpus=4,
+                       tpu_slice=_slice("late", i))
+        assert rt.get(ref, timeout=60) == 42
+    finally:
+        core_api._runtime = None
+        rt_.shutdown()
+        c.shutdown()
