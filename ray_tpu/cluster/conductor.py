@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.cluster.protocol import RpcServer, get_client
@@ -73,6 +73,14 @@ class Conductor:
         self._named_actors: Dict[Tuple[str, str], bytes] = {}
         self._object_locations: Dict[bytes, Set[bytes]] = defaultdict(set)
         self._object_spilled: Dict[bytes, str] = {}  # oid -> spill path/url
+        # --- distributed refcounting (reference_count.h:61, centralized;
+        #     counts driven by ordered event streams from every process) ---
+        self._refcounts: Dict[bytes, int] = {}
+        self._ref_children: Dict[bytes, List[bytes]] = {}
+        self._ref_tombstones: Set[bytes] = set()   # freed; stray seals die
+        self._ref_tombstone_order: deque = deque()
+        self._free_q: deque = deque()              # (node_addr, oid) deletes
+        self._free_cv = threading.Condition()
         self._pgs: Dict[bytes, PlacementGroupInfo] = {}
         self._task_events: List[dict] = []
         self._job_counter = 0
@@ -83,6 +91,9 @@ class Conductor:
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="conductor-health")
         self._health_thread.start()
+        self._free_thread = threading.Thread(
+            target=self._free_loop, daemon=True, name="conductor-free")
+        self._free_thread.start()
 
     # ------------------------------------------------------------------
     # Node membership + resource view (parity: GcsNodeManager + RaySyncer)
@@ -302,6 +313,14 @@ class Conductor:
     # ------------------------------------------------------------------
     def rpc_add_object_location(self, oid: bytes, node_id: bytes) -> None:
         with self._cv:
+            if oid in self._ref_tombstones:
+                # Sealed after its refcount hit zero (fire-and-forget task
+                # whose return refs were dropped pre-execution): delete the
+                # stray copy instead of registering a leaked location.
+                info = self._nodes.get(node_id)
+                if info is not None and info["alive"]:
+                    self._enqueue_delete(info["address"], oid)
+                return
             self._object_locations[oid].add(node_id)
             self._cv.notify_all()
 
@@ -313,6 +332,8 @@ class Conductor:
 
     def rpc_add_spilled(self, oid: bytes, url: str) -> None:
         with self._cv:
+            if oid in self._ref_tombstones:
+                return  # freed while the spill was in flight
             self._object_spilled[oid] = url
             self._cv.notify_all()
 
@@ -341,6 +362,125 @@ class Conductor:
         with self._lock:
             return [bool(self._object_locations.get(o)) or
                     o in self._object_spilled for o in oids]
+
+    def rpc_wait_objects(self, oids: List[bytes], num_needed: int,
+                         timeout: float = 0.0) -> List[bool]:
+        """Event-driven ray.wait / dependency-gate backend: long-poll until
+        at least ``num_needed`` of ``oids`` exist somewhere (location or
+        spill), then return the full existence bitmap. Replaces client-side
+        polling (parity: the reference's object-eviction/location pubsub,
+        src/ray/pubsub/publisher.h:302 — waiters park on the conductor's CV
+        and wake on add_object_location instead of spinning)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                exist = [bool(self._object_locations.get(o)) or
+                         o in self._object_spilled for o in oids]
+                if sum(exist) >= num_needed or timeout <= 0:
+                    return exist
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return exist
+                self._cv.wait(min(remaining, 1.0))
+
+    # ------------------------------------------------------------------
+    # Distributed refcounting (reference_count.h:61, centralized ledger)
+    # ------------------------------------------------------------------
+    def rpc_ref_update(self, deltas: List[tuple]) -> None:
+        """Apply an ordered batch of count events from one process.
+
+        Each event is ``(key, +1|-1)`` or ``(parent_key, [child_keys])``
+        (the parent object contains refs to the children). Order within the
+        batch is program order in the sender — applying sequentially is
+        what keeps handoffs race-free (see core/refcount.py docstring)."""
+        to_free: List[bytes] = []
+        with self._lock:
+            stack = list(deltas)
+            for key, ev in stack:
+                if isinstance(ev, list):
+                    if key in self._ref_tombstones:
+                        continue  # parent already freed; don't pin children
+                    self._ref_children.setdefault(key, []).extend(ev)
+                    for child in ev:
+                        self._refcounts[child] = \
+                            self._refcounts.get(child, 0) + 1
+                    continue
+                c = self._refcounts.get(key, 0) + ev
+                if c <= 0:
+                    had = key in self._refcounts
+                    self._refcounts.pop(key, None)
+                    # Free ONLY on a tracked 1->0 transition. A -1 against
+                    # an absent key (decref outliving a conductor restart)
+                    # must NOT free: the matching +1 may be lost state, and
+                    # other processes may still hold the object. Those
+                    # objects fall back to LRU/spill reclamation.
+                    if had:
+                        to_free.extend(self._collect_free(key))
+                else:
+                    self._refcounts[key] = c
+                    # A live count always overrides a stale tombstone (a
+                    # revived lineage output that regained holders).
+                    self._ref_tombstones.discard(key)
+        if to_free:
+            with self._cv:
+                self._cv.notify_all()
+
+    def _collect_free(self, key: bytes) -> List[bytes]:
+        """Free ``key`` and cascade to children whose counts hit zero.
+        Caller holds self._lock. Returns the freed keys."""
+        freed = []
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            if k in self._ref_tombstones:
+                continue
+            self._ref_tombstones.add(k)
+            self._ref_tombstone_order.append(k)
+            while len(self._ref_tombstone_order) > 200_000:
+                old = self._ref_tombstone_order.popleft()
+                self._ref_tombstones.discard(old)
+            freed.append(k)
+            for n in self._object_locations.pop(k, ()):
+                info = self._nodes.get(n)
+                if info is not None and info["alive"]:
+                    self._enqueue_delete(info["address"], k)
+            self._object_spilled.pop(k, None)
+            for child in self._ref_children.pop(k, ()):
+                c = self._refcounts.get(child, 0) - 1
+                if c <= 0:
+                    self._refcounts.pop(child, None)
+                    stack.append(child)
+                else:
+                    self._refcounts[child] = c
+        return freed
+
+    def rpc_ref_revive(self, keys: List[bytes]) -> None:
+        """Clear tombstones before lineage reconstruction re-executes a
+        task whose (freed) outputs are needed as dependencies again — the
+        recovered copies must be allowed to register locations."""
+        with self._lock:
+            for k in keys:
+                self._ref_tombstones.discard(k)
+
+    def _enqueue_delete(self, addr: str, oid: bytes) -> None:
+        with self._free_cv:
+            self._free_q.append((addr, oid))
+            self._free_cv.notify()
+
+    def _free_loop(self) -> None:
+        """Background deleter: store frees must not block RPC handlers."""
+        while not self._stopped:
+            with self._free_cv:
+                while not self._free_q and not self._stopped:
+                    self._free_cv.wait(1.0)
+                batch = []
+                while self._free_q:
+                    batch.append(self._free_q.popleft())
+            for addr, oid in batch:
+                try:
+                    get_client(addr).call("delete_object", oid=oid)
+                except Exception:
+                    pass
 
     def rpc_free_object(self, oid: bytes) -> None:
         with self._lock:

@@ -19,7 +19,7 @@ from ray_tpu.cluster.node_daemon import CHUNK_SIZE
 from ray_tpu.cluster.protocol import get_client
 from ray_tpu.core import serialization
 from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
-from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.ids import ObjectID, store_key
 
 
 class ObjectPlane:
@@ -33,8 +33,31 @@ class ObjectPlane:
 
     # -- write ----------------------------------------------------------
     def put_value(self, oid: ObjectID, value: Any) -> int:
-        blob, _refs = serialization.serialize(value)
-        return self.put_blob(oid, blob)
+        """Serialize + store, copying large buffers once (straight into the
+        shm mapping). Contained ObjectRefs are registered as children so
+        the stored object keeps them alive (reference_count.h nested refs).
+        """
+        total, segments, refs = serialization.serialize_segments(value)
+        key = self._key(oid)
+        if refs:
+            from ray_tpu.core import refs as _refs_mod
+            t = _refs_mod._tracker
+            if t is not None:
+                t.add_children(key, [store_key(r.id.binary()) for r in refs])
+        try:
+            buf = self.store.create(key, total)
+            off = 0
+            for seg in segments:
+                m = memoryview(seg)
+                buf[off:off + m.nbytes] = m
+                off += m.nbytes
+            self.store.seal(key)
+        except object_client.ObjectStoreError as e:
+            if "already exists" not in str(e):
+                raise
+        self.conductor.call("add_object_location", oid=key,
+                            node_id=self.node_id)
+        return total
 
     def put_blob(self, oid: ObjectID, blob: bytes) -> int:
         key = self._key(oid)
@@ -53,8 +76,7 @@ class ObjectPlane:
     # -- read -----------------------------------------------------------
     def _key(self, oid: ObjectID) -> bytes:
         # shmstored keys are 16 bytes; ObjectIDs are 20 (task id + index).
-        import hashlib
-        return hashlib.blake2b(oid.binary(), digest_size=16).digest()
+        return store_key(oid.binary())
 
     def contains(self, oid: ObjectID) -> bool:
         try:

@@ -1,0 +1,107 @@
+"""Durable control-plane state: snapshot + append-only journal.
+
+Role parity: src/ray/gcs/gcs_server/gcs_table_storage.h (per-table durable
+writes), store_client/redis_store_client.h (the backing store; here a local
+file pair in the session dir — the conductor is single-node the way a
+one-replica Redis is), and gcs_init_data.h (bulk load on restart).
+
+Only DURABLE tables are journaled: nodes, actors, placement groups, KV,
+function table, job counter. Volatile state (object directory, reference
+counts, task events) is rebuilt after failover: node daemons re-advertise
+their store contents when they observe a new conductor epoch, and ref
+trackers resync their full ledger (core/refcount.py).
+
+Format: both files are sequences of [4B little-endian length][pickle
+(kind, data)] frames. ``<prefix>.snap`` holds one frame (a full snapshot);
+``<prefix>.log`` holds mutations since that snapshot. Loads tolerate a torn
+tail frame (crash mid-append) by stopping at the first bad frame.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+from typing import Any, Iterator, List, Optional, Tuple
+
+
+def _read_frames(path: str) -> Iterator[Tuple[str, Any]]:
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                return
+            (length,) = struct.unpack("<I", hdr)
+            body = f.read(length)
+            if len(body) < length:
+                return  # torn tail: crash mid-append
+            try:
+                yield pickle.loads(body)
+            except Exception:
+                return
+
+
+class StateJournal:
+    """Append-mutations / snapshot-compaction pair for one conductor."""
+
+    COMPACT_EVERY = 5000  # mutations between snapshots
+
+    def __init__(self, prefix: str):
+        self.snap_path = prefix + ".snap"
+        self.log_path = prefix + ".log"
+        os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._log_file = None
+        self._appended = 0
+
+    # -- load -----------------------------------------------------------
+    def load(self) -> Tuple[Optional[dict], List[Tuple[str, Any]]]:
+        """Returns (snapshot or None, ordered mutation records)."""
+        snapshot = None
+        for kind, data in _read_frames(self.snap_path):
+            if kind == "snapshot":
+                snapshot = data
+        records = list(_read_frames(self.log_path))
+        return snapshot, records
+
+    # -- write ----------------------------------------------------------
+    def _frame(self, kind: str, data: Any) -> bytes:
+        body = pickle.dumps((kind, data), protocol=5)
+        return struct.pack("<I", len(body)) + body
+
+    def append(self, kind: str, data: Any) -> bool:
+        """Append one mutation. Returns True when a compaction is due."""
+        frame = self._frame(kind, data)
+        with self._lock:
+            if self._log_file is None:
+                self._log_file = open(self.log_path, "ab")
+            self._log_file.write(frame)
+            self._log_file.flush()
+            self._appended += 1
+            return self._appended >= self.COMPACT_EVERY
+
+    def snapshot(self, state: dict) -> None:
+        """Write a full snapshot and truncate the journal."""
+        tmp = self.snap_path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                f.write(self._frame("snapshot", state))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.snap_path)
+            if self._log_file is not None:
+                self._log_file.close()
+            self._log_file = open(self.log_path, "wb")
+            self._appended = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._log_file is not None:
+                try:
+                    self._log_file.close()
+                except OSError:
+                    pass
+                self._log_file = None

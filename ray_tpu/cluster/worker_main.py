@@ -94,6 +94,9 @@ class WorkerService:
         self._seq_lock = threading.Lock()
         self._seq_cv = threading.Condition(self._seq_lock)
         self._next_seq: Dict[bytes, int] = {}
+        # Pins taken over from callers for not-yet-run enqueued actor work;
+        # released on kill/exit so a dead actor doesn't leak its arguments.
+        self._taken_pins: Dict[bytes, int] = {}
         self._shutdown = threading.Event()
 
     # ------------------------------------------------------------------
@@ -111,13 +114,38 @@ class WorkerService:
         return fn
 
     def _resolve(self, args_blob: bytes):
+        from ray_tpu import config
+        from ray_tpu.core.exceptions import ObjectLostError
+        from ray_tpu.core.exceptions import GetTimeoutError
         from ray_tpu.core.refs import ObjectRef
         args, kwargs = serialization.loads(args_blob)
+        # Bounded fetch: a dependency that was GC-freed or lost without
+        # lineage must fail the task (visible to the caller) rather than
+        # hang this worker forever.
+        timeout = config.get("worker_fetch_timeout_s")
 
         def rv(v):
-            return self.plane.get_value(v.id) if isinstance(v, ObjectRef) else v
+            if not isinstance(v, ObjectRef):
+                return v
+            try:
+                return self.plane.get_value(v.id, timeout=timeout)
+            except GetTimeoutError:
+                raise ObjectLostError(
+                    v.id.hex(), f"task argument unavailable after "
+                    f"{timeout}s (freed or lost)") from None
 
         return [rv(a) for a in args], {k: rv(v) for k, v in kwargs.items()}
+
+    def _flush_refs(self) -> None:
+        """Ship this process's pending refcount events to the conductor
+        BEFORE acking a push RPC — the submitter releases its in-flight
+        argument pins on the ack, so any +1 this execution produced (user
+        code keeping a borrowed ref) must be in the ledger first
+        (core/refcount.py ordering protocol)."""
+        from ray_tpu.core import refs as _refs_mod
+        t = _refs_mod._tracker
+        if t is not None:
+            t.flush()
 
     def _store_returns(self, task_id: bytes, num_returns: int, result: Any):
         tid = TaskID(task_id)
@@ -166,6 +194,7 @@ class WorkerService:
             except BaseException as e:  # noqa: BLE001 - delivered via refs
                 error = repr(e)
                 self._fail_returns(task_id, num_returns, e, name)
+        self._flush_refs()
         self.events.record(task_id, name, "task", start, time.time(), error)
         return {"ok": True}
 
@@ -235,13 +264,28 @@ class WorkerService:
 
     def rpc_push_actor_task(self, task_id: bytes, caller_id: bytes,
                             seqno: int, method_name: str, args_blob: bytes,
-                            num_returns: int) -> dict:
+                            num_returns: int,
+                            arg_pins: Optional[list] = None) -> dict:
         """Ordered actor call (per-caller seqno; see class docstring)."""
         if self.actor_instance is None:
             raise RuntimeError("no actor hosted on this worker")
         name = f"{self.actor_class_name}.{method_name}"
         start = time.time()
         error = ""
+
+        def unpin_args():
+            if not arg_pins:
+                return
+            from ray_tpu.core import refs as _refs_mod
+            t = _refs_mod._tracker
+            if t is not None:
+                t.unpin_all(arg_pins)
+            with self._seq_lock:
+                for k in arg_pins:
+                    if self._taken_pins.get(k, 0) > 1:
+                        self._taken_pins[k] -= 1
+                    else:
+                        self._taken_pins.pop(k, None)
 
         def run_sync():
             err = ""
@@ -254,6 +298,22 @@ class WorkerService:
                 err = repr(e)
                 self._fail_returns(task_id, num_returns, e, name)
             return err
+
+        def take_over_pins():
+            """Enqueue-ack paths: the caller unpins its in-flight argument
+            pins when this RPC returns, but execution happens later — take
+            the pins over HERE (flushed before the ack) so the argument
+            objects survive the gap (core/refcount.py ordering). Tracked in
+            _taken_pins so a kill before execution releases them."""
+            if not arg_pins:
+                return
+            from ray_tpu.core import refs as _refs_mod
+            t = _refs_mod._tracker
+            if t is not None:
+                t.pin_all(arg_pins)
+            with self._seq_lock:
+                for k in arg_pins:
+                    self._taken_pins[k] = self._taken_pins.get(k, 0) + 1
 
         if self.actor_is_async:
             # Ordered start, concurrent awaits (parity: async actors).
@@ -271,10 +331,13 @@ class WorkerService:
                 except BaseException as e:  # noqa: BLE001
                     err = repr(e)
                     self._fail_returns(task_id, num_returns, e, name)
+                finally:
+                    unpin_args()
                 return err
 
             if not self._wait_turn(caller_id, seqno):
                 return {"ok": True, "duplicate": True}
+            take_over_pins()
             asyncio.run_coroutine_threadsafe(run_async(), self.actor_loop)
             self._done_turn(caller_id, seqno)
             # Ack on enqueue: concurrent awaits must overlap, so completion
@@ -285,7 +348,15 @@ class WorkerService:
             # (parity: out_of_order_actor_scheduling_queue.h).
             if not self._wait_turn(caller_id, seqno):
                 return {"ok": True, "duplicate": True}
-            self.actor_pool.submit(run_sync)
+            take_over_pins()
+
+            def run_and_unpin():
+                try:
+                    run_sync()
+                finally:
+                    unpin_args()
+
+            self.actor_pool.submit(run_and_unpin)
             self._done_turn(caller_id, seqno)
             return {"ok": True, "enqueued": True}
         else:
@@ -295,12 +366,24 @@ class WorkerService:
                 error = run_sync()
             finally:
                 self._done_turn(caller_id, seqno)
+            self._flush_refs()
         self.events.record(task_id, name, "actor_task", start, time.time(),
                            error)
         return {"ok": True}
 
+    def _release_taken_pins(self) -> None:
+        from ray_tpu.core import refs as _refs_mod
+        t = _refs_mod._tracker
+        with self._seq_lock:
+            pins, self._taken_pins = self._taken_pins, {}
+        if t is not None and pins:
+            for k, n in pins.items():
+                t.unpin_all([k] * n)
+            t.flush()
+
     def rpc_kill_actor(self, actor_id: bytes) -> dict:
         self.events.flush()
+        self._release_taken_pins()
         try:
             get_client(self.daemon_address).call("actor_exited",
                                                  actor_id=actor_id)
@@ -314,6 +397,7 @@ class WorkerService:
         return "pong"
 
     def rpc_exit(self) -> dict:
+        self._release_taken_pins()
         self._shutdown.set()
         threading.Timer(0.05, lambda: os._exit(0)).start()
         return {"ok": True}

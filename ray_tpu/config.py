@@ -115,6 +115,13 @@ define("max_pending_lease_requests", int, 10, "In-flight lease requests per key.
 define("health_check_period_s", float, 1.0, "Conductor -> node liveness ping period.")
 define("health_check_timeout_s", float, 10.0, "Misses before a node is marked dead.")
 define("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
+define("max_lineage_bytes", int, 256 * 1024 * 1024,
+       "Byte budget for retained task lineage (args blobs) per submitter; "
+       "done+unreferenced records evict first (ray_config_def.h "
+       "max_lineage_bytes role).")
+define("worker_fetch_timeout_s", float, 120.0,
+       "Executor-side bound on fetching a task argument; a freed/lost dep "
+       "fails the task instead of hanging the worker.")
 define("actor_max_restarts_default", int, 0, "Default actor restarts.")
 define("testing_rpc_delay_us", str, "",
        "Deterministic delay injected before serving matching RPCs; format "

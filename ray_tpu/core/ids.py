@@ -7,6 +7,7 @@ wrapper so they cannot be mixed up.
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 
@@ -56,6 +57,15 @@ class BaseID:
 
 class ObjectID(BaseID):
     SIZE = 20
+
+
+def store_key(oid_binary: bytes) -> bytes:
+    """16-byte shm-store / directory key for a 20-byte ObjectID.
+
+    Every subsystem that names an object outside this process (shm store,
+    conductor object directory, reference ledger) uses this one mapping.
+    """
+    return hashlib.blake2b(oid_binary, digest_size=16).digest()
 
 
 class TaskID(BaseID):

@@ -1,0 +1,145 @@
+"""Process-local reference ledger + batched updates to the conductor.
+
+Role parity: src/ray/core_worker/reference_count.h:61 — the reference
+counter that keeps an object alive while any handle, in-flight task
+argument, or containing object can still reach it, and frees its store
+copies when the count drops to zero. The reference keeps the ledger on the
+object's owner worker; here ownership is centralized on the conductor
+(matching the centralized object directory), so every process ships an
+ORDERED stream of count events and the conductor applies them in order:
+
+- ``handle_created`` / ``handle_dropped``: an ``ObjectRef`` instance was
+  created/garbage-collected in this process. Only the 0<->1 transitions of
+  the process-local count become events.
+- ``pin`` / ``unpin``: an explicit +1/-1 (in-flight task arguments between
+  submit and execution-ack; recovery pins).
+- ``add_children``: a stored object contains serialized ObjectRefs — the
+  children must outlive the parent (reference_count.h nested-ref tracking);
+  the conductor +1s each child and -1s them when the parent is freed.
+
+Ordering is what makes the protocol race-free without per-borrower state:
+within one process, events flush in program order; across the task-arg
+handoff, the executing worker flushes its events BEFORE acking the push
+RPC, and the submitter unpins only AFTER the ack — so a borrower's +1
+always reaches the conductor before the submitter's balancing -1.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import store_key
+
+_FLUSH_INTERVAL_S = 0.05
+_FLUSH_BATCH = 2000
+
+
+class RefTracker:
+    def __init__(self, conductor_client):
+        self._cli = conductor_client
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # oid binary (20B) -> number of live ObjectRef handles here
+        self._local: Dict[bytes, int] = {}
+        # store key (16B) -> live explicit pins from this process (kept so
+        # a conductor-failover resync can replay this process's full truth)
+        self._pins: Dict[bytes, int] = {}
+        # ordered outbound events: (key16, ±1) or (key16, [child keys])
+        self._events: List[Tuple[bytes, object]] = []
+        self._stopped = False
+        self._flush_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ref-flush")
+        self._thread.start()
+
+    # -- handle lifecycle (called from ObjectRef __init__/__del__) ------
+    def handle_created(self, oid: bytes) -> None:
+        with self._cv:
+            c = self._local.get(oid, 0)
+            self._local[oid] = c + 1
+            if c == 0:
+                self._events.append((store_key(oid), 1))
+                if len(self._events) >= _FLUSH_BATCH:
+                    self._cv.notify()
+
+    def handle_dropped(self, oid: bytes) -> None:
+        with self._cv:
+            c = self._local.get(oid, 0) - 1
+            if c <= 0:
+                self._local.pop(oid, None)
+                self._events.append((store_key(oid), -1))
+            else:
+                self._local[oid] = c
+
+    def holds(self, oid: bytes) -> bool:
+        """True while this process has live handles to ``oid`` (used by the
+        lineage evictor: records for still-referenced objects must stay)."""
+        with self._lock:
+            return self._local.get(oid, 0) > 0
+
+    # -- explicit pins (submitter-side in-flight task args) -------------
+    def pin_all(self, keys: List[bytes], flush: bool = True) -> None:
+        """Pin keys and (by default) flush SYNCHRONOUSLY. The flush is what
+        upholds the cross-process invariant: a ref may only leave this
+        process (task args, stored containers) once this process's +1s are
+        durable at the conductor — otherwise a borrower's transient +1/-1
+        pair can transit the count through zero and free a live object."""
+        with self._lock:
+            for k in keys:
+                self._pins[k] = self._pins.get(k, 0) + 1
+                self._events.append((k, 1))
+        if flush:
+            self.flush()
+
+    def unpin_all(self, keys: List[bytes]) -> None:
+        with self._lock:
+            for k in keys:
+                c = self._pins.get(k, 0) - 1
+                if c <= 0:
+                    self._pins.pop(k, None)
+                else:
+                    self._pins[k] = c
+                self._events.append((k, -1))
+
+    def add_children(self, parent_key: bytes, child_keys: List[bytes],
+                     flush: bool = True) -> None:
+        """Register parent->children containment. Flushed synchronously by
+        default for the same reason as pin_all: the children's +1s must be
+        durable before the parent object becomes readable (a getter could
+        otherwise deserialize + drop child handles whose net-zero event
+        pair outruns this registration)."""
+        with self._lock:
+            self._events.append((parent_key, list(child_keys)))
+        if flush:
+            self.flush()
+
+    # -- flushing -------------------------------------------------------
+    def flush(self) -> None:
+        """Ship buffered events, preserving order. Safe to call from any
+        thread; the executing-worker ack path calls this synchronously."""
+        with self._flush_lock:  # one flusher at a time keeps the order
+            with self._lock:
+                events, self._events = self._events, []
+            if not events:
+                return
+            try:
+                self._cli.call("ref_update", deltas=events)
+            except Exception:
+                # Conductor unreachable (shutdown / failover window). The
+                # store's LRU+spill is the backstop; do not crash refs.
+                pass
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._stopped and not self._events:
+                    return
+                self._cv.wait(_FLUSH_INTERVAL_S)
+            self.flush()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self.flush()

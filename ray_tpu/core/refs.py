@@ -12,15 +12,35 @@ from typing import Optional
 
 from ray_tpu.core.ids import ObjectID
 
+# Installed by ClusterRuntime._finish_init; None in local mode / no runtime.
+# Every ObjectRef created (including by deserialization in a borrowing
+# worker) registers here, and deregisters on GC — the distributed refcount
+# (reference_count.h:61) is driven entirely by these two hooks plus the
+# submitter's explicit in-flight-arg pins (core/refcount.py).
+_tracker = None
+
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner", "__weakref__")
+    __slots__ = ("_id", "_owner", "_tracked", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner: Optional[str] = None):
         self._id = object_id
         # Owner address string ("host:port" of the owning worker/driver) —
         # lets any holder resolve the object's location via the owner.
         self._owner = owner
+        t = _tracker
+        self._tracked = t is not None
+        if t is not None:
+            t.handle_created(object_id.binary())
+
+    def __del__(self):
+        if self._tracked:
+            t = _tracker
+            if t is not None:
+                try:
+                    t.handle_dropped(self._id.binary())
+                except Exception:
+                    pass  # interpreter teardown
 
     @property
     def id(self) -> ObjectID:

@@ -71,8 +71,14 @@ def _restore_array(arr):
     return arr
 
 
-def serialize(value: Any) -> Tuple[bytes, List[ObjectRef]]:
-    """Serialize ``value``; returns (blob, contained ObjectRefs)."""
+def serialize_segments(value: Any) -> Tuple[int, List, List[ObjectRef]]:
+    """Serialize ``value`` into (total_len, segments, contained refs).
+
+    Segments are bytes/memoryviews whose concatenation is the wire blob;
+    large buffers stay as views so the object-plane put can copy them ONCE,
+    directly into the destination shm mapping (the reference's plasma put
+    is likewise single-copy, core_worker.cc:1095).
+    """
     import io
 
     buffers: List[pickle.PickleBuffer] = []
@@ -86,6 +92,8 @@ def serialize(value: Any) -> Tuple[bytes, List[ObjectRef]]:
         m = b.raw()
         if not m.contiguous:
             m = memoryview(bytes(m))
+        if m.format != "B" or m.ndim != 1:
+            m = m.cast("B")
         raw.append(m)
 
     header = bytearray()
@@ -94,13 +102,27 @@ def serialize(value: Any) -> Tuple[bytes, List[ObjectRef]]:
     for m in raw:
         header += struct.pack("<Q", m.nbytes)
 
-    out = bytearray(header)
-    out += pickled
-    out += b"\x00" * _pad(len(out))
+    segments: List = [bytes(header) + pickled]
+    total = len(segments[0])
+    pad = _pad(total)
+    if pad:
+        segments.append(b"\x00" * pad)
+        total += pad
     for m in raw:
-        out += m
-        out += b"\x00" * _pad(len(out))
-    return bytes(out), p.contained_refs
+        segments.append(m)
+        total += m.nbytes
+        pad = _pad(total)
+        if pad:
+            segments.append(b"\x00" * pad)
+            total += pad
+    return total, segments, p.contained_refs
+
+
+def serialize(value: Any) -> Tuple[bytes, List[ObjectRef]]:
+    """Serialize ``value``; returns (blob, contained ObjectRefs)."""
+    total, segments, refs = serialize_segments(value)
+    return b"".join(bytes(s) if not isinstance(s, bytes) else s
+                    for s in segments), refs
 
 
 def serialized_size(blob: bytes) -> int:
@@ -138,6 +160,19 @@ def deserialize(blob) -> Any:
 def dumps(value: Any) -> bytes:
     """Plain cloudpickle (control-plane payloads: task specs, functions)."""
     return cloudpickle.dumps(value, protocol=5)
+
+
+def dumps_with_refs(value: Any) -> Tuple[bytes, List[ObjectRef]]:
+    """In-band cloudpickle that also reports every ObjectRef reachable from
+    ``value`` (at any nesting depth) in ONE pass — the submit path pins
+    these for the duration of the task handoff (reference_count.h:61
+    in-flight argument references)."""
+    import io
+
+    bio = io.BytesIO()
+    p = _Pickler(bio, None)
+    p.dump(value)
+    return bio.getvalue(), p.contained_refs
 
 
 def loads(blob: bytes) -> Any:
