@@ -173,29 +173,47 @@ class WorkerService:
     # ------------------------------------------------------------------
     # normal tasks
     # ------------------------------------------------------------------
+    def _exec_one(self, task_id: bytes, function_id: str,
+                  function_blob: Optional[bytes], args_blob: bytes,
+                  num_returns: int, name: str) -> None:
+        """Execute one task body; returns are stored before this returns.
+        Caller holds _exec_lock (serial normal-task execution)."""
+        start = time.time()
+        if task_id in self._cancelled:
+            self._cancelled.discard(task_id)
+            from ray_tpu.core.exceptions import TaskCancelledError
+            self._fail_returns(task_id, num_returns,
+                               TaskCancelledError("task cancelled"), name)
+            return
+        error = ""
+        try:
+            fn = self._load_fn(function_id, function_blob)
+            args, kwargs = self._resolve(args_blob)
+            result = fn(*args, **kwargs)
+            self._store_returns(task_id, num_returns, result)
+        except BaseException as e:  # noqa: BLE001 - delivered via refs
+            error = repr(e)
+            self._fail_returns(task_id, num_returns, e, name)
+        self.events.record(task_id, name, "task", start, time.time(), error)
+
     def rpc_push_task(self, task_id: bytes, function_id: str,
                       function_blob: Optional[bytes], args_blob: bytes,
                       num_returns: int, name: str = "") -> dict:
-        """Execute one task; ack after its returns are stored."""
-        start = time.time()
+        """Single-task compat shim over the batch path."""
+        return self.rpc_push_task_batch([{
+            "task_id": task_id, "function_id": function_id,
+            "function_blob": function_blob, "args_blob": args_blob,
+            "num_returns": num_returns, "name": name}])
+
+    def rpc_push_task_batch(self, tasks: list) -> dict:
+        """Execute a coalesced batch serially; one ack for all (the
+        submitter batches deep queues — core/runtime_cluster.py _pump)."""
         with self._exec_lock:
-            if task_id in self._cancelled:
-                self._cancelled.discard(task_id)
-                from ray_tpu.core.exceptions import TaskCancelledError
-                self._fail_returns(task_id, num_returns,
-                                   TaskCancelledError("task cancelled"), name)
-                return {"ok": True, "cancelled": True}
-            error = ""
-            try:
-                fn = self._load_fn(function_id, function_blob)
-                args, kwargs = self._resolve(args_blob)
-                result = fn(*args, **kwargs)
-                self._store_returns(task_id, num_returns, result)
-            except BaseException as e:  # noqa: BLE001 - delivered via refs
-                error = repr(e)
-                self._fail_returns(task_id, num_returns, e, name)
+            for t in tasks:
+                self._exec_one(t["task_id"], t["function_id"],
+                               t.get("function_blob"), t["args_blob"],
+                               t["num_returns"], t.get("name", ""))
         self._flush_refs()
-        self.events.record(task_id, name, "task", start, time.time(), error)
         return {"ok": True}
 
     def rpc_cancel_task(self, task_id: bytes) -> None:

@@ -44,6 +44,7 @@ from ray_tpu.core.task_spec import FunctionDescriptor
 
 _LEASE_LINGER_S = 0.25     # idle lease kept briefly for reuse
 _MAX_LEASES_PER_KEY = 64
+_PUSH_BATCH = 8            # tasks coalesced per push RPC when queues are deep
 
 
 class _LeasedWorker:
@@ -63,11 +64,13 @@ class _KeyState:
         self.queue: deque = deque()          # task dicts
         self.busy = 0
         self.pending_leases = 0
+        self.active: set = set()             # workers with an in-flight push
         self.lock = threading.Lock()
 
 
 class _TaskRecord:
-    __slots__ = ("task", "retries_left", "done", "cancelled", "submitted_at")
+    __slots__ = ("task", "retries_left", "done", "cancelled", "submitted_at",
+                 "solo")
 
     def __init__(self, task: dict, retries_left: int):
         self.task = task
@@ -75,6 +78,10 @@ class _TaskRecord:
         self.done = False
         self.cancelled = False
         self.submitted_at = time.monotonic()
+        # After a batch push fails, every member is resubmitted solo: the
+        # poison task alone is charged a retry on its next (solo) failure,
+        # and healthy batch-mates stop being re-coalesced with it.
+        self.solo = False
 
     def nbytes(self) -> int:
         return len(self.task.get("args_blob") or b"")
@@ -249,7 +256,11 @@ class TaskSubmitter:
         self._pump(st)
 
     def _pump(self, st: _KeyState) -> None:
-        """Dispatch queued tasks onto idle leases; grow the pool if short."""
+        """Dispatch queued tasks onto idle leases; grow the pool if short.
+
+        Deep queues coalesce up to _PUSH_BATCH tasks into ONE push RPC per
+        worker (the worker executes serially either way; batching cuts the
+        per-task RPC + thread-dispatch cost that GIL-bounds the driver)."""
         while True:
             with st.lock:
                 while st.queue and st.queue[0].cancelled:
@@ -258,8 +269,17 @@ class TaskSubmitter:
                     return
                 if st.idle:
                     w = st.idle.popleft()
-                    rec = st.queue.popleft()
+                    recs = [st.queue.popleft()]
+                    # Coalesce only genuine backlog: tasks beyond what the
+                    # idle pool AND in-flight lease grants will absorb.
+                    while (st.queue and len(recs) < _PUSH_BATCH and
+                           not recs[0].solo and not st.queue[0].solo and
+                           len(st.queue) > len(st.idle) + st.pending_leases):
+                        r = st.queue.popleft()
+                        if not r.cancelled:
+                            recs.append(r)
                     st.busy += 1
+                    st.active.add(w)
                 else:
                     need = len(st.queue)
                     have = st.busy + len(st.idle) + st.pending_leases
@@ -271,7 +291,7 @@ class TaskSubmitter:
                         self._lease_pool.submit(self._acquire_lease, st,
                                                 dict(rec0.task))
                     return
-            self._pool.submit(self._run_on, st, w, rec)
+            self._pool.submit(self._run_on, st, w, recs)
 
     def _acquire_lease(self, st: _KeyState, task: dict) -> None:
         try:
@@ -303,16 +323,19 @@ class TaskSubmitter:
         the release atomic against a cancel()/completion race."""
         self.rt._unpin_task(rec.task)
 
-    def _run_on(self, st: _KeyState, w: _LeasedWorker, rec: _TaskRecord) -> None:
-        task = rec.task
+    def _run_on(self, st: _KeyState, w: _LeasedWorker,
+                recs: List[_TaskRecord]) -> None:
         try:
             get_client(w.address).call(
-                "push_task", task_id=task["task_id"],
-                function_id=task["function_id"],
-                function_blob=None, args_blob=task["args_blob"],
-                num_returns=task["num_returns"], name=task["name"])
-            rec.done = True
-            self._unpin_args(rec)
+                "push_task_batch",
+                tasks=[{"task_id": r.task["task_id"],
+                        "function_id": r.task["function_id"],
+                        "args_blob": r.task["args_blob"],
+                        "num_returns": r.task["num_returns"],
+                        "name": r.task["name"]} for r in recs])
+            for rec in recs:
+                rec.done = True
+                self._unpin_args(rec)
         except (ConnectionLost, OSError, RpcError):
             w.alive = False
             from ray_tpu.cluster.protocol import drop_client
@@ -320,32 +343,44 @@ class TaskSubmitter:
             self.rt._drop_lease(w)
             with st.lock:
                 st.busy -= 1
-            if rec.retries_left != 0:
-                if rec.retries_left > 0:
-                    rec.retries_left -= 1
+                st.active.discard(w)
+            # Only a SOLO failure charges the task's retries: a worker dying
+            # under a batch doesn't identify the culprit, so batch-mates
+            # resubmit solo and uncharged.
+            charged = [rec for rec in recs
+                       if len(recs) == 1 and rec.retries_left == 0]
+            retriable = [rec for rec in recs if rec not in charged]
+            if retriable:
                 # brief backoff so the daemon's reaper notices the dead
                 # worker before the retry re-leases (avoids burning every
                 # retry on the same dying process)
                 time.sleep(0.25)
+            for rec in retriable:
+                if len(recs) == 1 and rec.retries_left > 0:
+                    rec.retries_left -= 1
+                rec.solo = True
                 self._enqueue(rec)
-            else:
+            for rec in charged:
                 err = TaskError.from_exception(
-                    ObjectLostError(task["task_id"].hex(),
+                    ObjectLostError(rec.task["task_id"].hex(),
                                     "worker died and no retries left"),
-                    task["name"])
-                self.rt._store_error_returns(task, err)
+                    rec.task["name"])
+                self.rt._store_error_returns(rec.task, err)
                 self._unpin_args(rec)
             return
         except BaseException as e:  # noqa: BLE001 - surfaced via refs
             with st.lock:
                 st.busy -= 1
-            self.rt._store_error_returns(task, TaskError.from_exception(
-                e, task["name"]))
-            self._unpin_args(rec)
+                st.active.discard(w)
+            for rec in recs:
+                self.rt._store_error_returns(
+                    rec.task, TaskError.from_exception(e, rec.task["name"]))
+                self._unpin_args(rec)
             self._return_worker(st, w)
             return
         with st.lock:
             st.busy -= 1
+            st.active.discard(w)
         self._return_worker(st, w)
 
     def _return_worker(self, st: _KeyState, w: _LeasedWorker) -> None:
@@ -756,9 +791,39 @@ class ClusterRuntime:
             except Exception:
                 pass
 
+    def _prewait(self, refs: List[ObjectRef], deadline: Optional[float],
+                 budget_s: float = 4.0) -> None:
+        """Batched accelerator for multi-ref get: ONE wait_objects long-poll
+        parks until (most of) the set exists, so the per-ref getters below
+        mostly hit their local fast path instead of each long-polling the
+        directory. Bounded: exits on completion, stall (letting _get_one's
+        recovery machinery engage), deadline, or budget."""
+        keys = [self.plane._key(r.id) for r in refs]
+        budget_end = time.monotonic() + budget_s
+        last = -1
+        while True:
+            now = time.monotonic()
+            step = min(2.0, budget_end - now)
+            if deadline is not None:
+                step = min(step, deadline - now)
+            if step <= 0:
+                return
+            try:
+                exist = self.conductor.call(
+                    "wait_objects", oids=keys, num_needed=len(keys),
+                    timeout=step, _timeout=step + 10.0)
+            except Exception:
+                return
+            n = sum(exist)
+            if n >= len(keys) or n <= last:
+                return
+            last = n
+
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
+        if len(refs) > 4:
+            self._prewait(refs, deadline)
         if len(refs) <= 1:
             return [self._get_one(ref, deadline) for ref in refs]
         # Resolve concurrently: N remote objects fetch in parallel (the
@@ -1074,11 +1139,12 @@ class ClusterRuntime:
             return
         rec.cancelled = True  # dropped from queues by _pump/_dep_loop
         # Best effort for an already-dispatched task: tell every leased
-        # worker of this key to skip it if it hasn't started yet.
+        # worker of this key (idle AND mid-batch busy) to skip it if it
+        # hasn't started yet.
         st = self.submitter._keys.get(rec.task.get("key"))
         if st is not None:
             with st.lock:
-                workers = list(st.idle)
+                workers = list(st.idle) + list(st.active)
             for w in workers:
                 try:
                     get_client(w.address).call("cancel_task",
