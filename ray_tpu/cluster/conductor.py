@@ -63,9 +63,23 @@ class Conductor:
     """In-memory control-plane tables + schedulers, served over RpcServer."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 health_timeout_s: float = 10.0):
+                 health_timeout_s: float = 10.0,
+                 persist_dir: Optional[str] = None):
+        import uuid
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # Epoch: fresh per conductor process. Daemons and ref trackers
+        # compare it on every exchange; a change means "the conductor
+        # restarted — re-advertise your volatile state" (gcs_init_data.h
+        # role: durable tables reload from disk, volatile state resyncs
+        # from the fleet).
+        self._epoch = uuid.uuid4().hex
+        self._journal = None
+        self._compact_due = False
+        if persist_dir is not None:
+            from ray_tpu.cluster.persistence import StateJournal
+            self._journal = StateJournal(
+                persist_dir.rstrip("/") + "/conductor")
         self._nodes: Dict[bytes, dict] = {}          # node_id -> info
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
         self._functions: Dict[str, bytes] = {}       # function_id -> blob
@@ -79,6 +93,8 @@ class Conductor:
         self._ref_children: Dict[bytes, List[bytes]] = {}
         self._ref_tombstones: Set[bytes] = set()   # freed; stray seals die
         self._ref_tombstone_order: deque = deque()
+        self._ref_batches_seen: Set[str] = set()   # at-least-once dedup
+        self._ref_batch_order: deque = deque()
         self._free_q: deque = deque()              # (node_addr, oid) deletes
         self._free_cv = threading.Condition()
         self._pgs: Dict[bytes, PlacementGroupInfo] = {}
@@ -86,6 +102,14 @@ class Conductor:
         self._job_counter = 0
         self._health_timeout_s = health_timeout_s
         self._stopped = False
+        # worker-log pubsub ring (log streaming to drivers / `job logs`).
+        # Own CV: log polls must not wake on (or scan under) the global
+        # control-plane lock's notify_all traffic.
+        self._log_cv = threading.Condition()
+        self._log_buffer: deque = deque(maxlen=20000)
+        self._log_seq = 0
+        if self._journal is not None:
+            self._restore()
         self.server = RpcServer(self, host=host, port=port)
         self.address = self.server.address
         self._health_thread = threading.Thread(
@@ -94,6 +118,157 @@ class Conductor:
         self._free_thread = threading.Thread(
             target=self._free_loop, daemon=True, name="conductor-free")
         self._free_thread.start()
+
+    # ------------------------------------------------------------------
+    # Durable state (parity: gcs_table_storage.h writes, gcs_init_data.h
+    # bulk load). Only control tables persist; see persistence.py.
+    # ------------------------------------------------------------------
+    def _log(self, kind: str, data: dict) -> None:
+        """Journal one durable mutation. Caller may hold self._lock (the
+        journal has its own lock and does no RPC)."""
+        if self._journal is None:
+            return
+        try:
+            if self._journal.append(kind, data):
+                self._compact_due = True
+        except OSError:
+            pass
+
+    def _actor_record(self, a: "ActorInfo") -> dict:
+        return {"actor_id": a.actor_id, "state": a.state,
+                "address": a.address, "node_id": a.node_id,
+                "num_restarts": a.num_restarts,
+                "death_reason": a.death_reason,
+                "incarnation": a.incarnation}
+
+    def _durable_state(self) -> dict:
+        """Full durable-state snapshot. Caller holds self._lock."""
+        return {
+            "nodes": [
+                {k: v for k, v in info.items() if k != "last_heartbeat"}
+                for info in self._nodes.values()],
+            "actors": [
+                {"spec": a.spec, **self._actor_record(a)}
+                for a in self._actors.values()],
+            "pgs": [
+                {"pg_id": pg.pg_id, "bundles": pg.bundles,
+                 "strategy": pg.strategy, "name": pg.name,
+                 "slice_topology": pg.slice_topology, "state": pg.state,
+                 "bundle_nodes": pg.bundle_nodes, "slice_id": pg.slice_id}
+                for pg in self._pgs.values()],
+            "kv": dict(self._kv),
+            "functions": dict(self._functions),
+            "job_counter": self._job_counter,
+        }
+
+    def _apply_snapshot(self, snap: dict) -> None:
+        now = time.monotonic()
+        for info in snap.get("nodes", ()):
+            info = dict(info)
+            info["last_heartbeat"] = now  # grace: health re-evaluates
+            self._nodes[info["node_id"]] = info
+        for rec in snap.get("actors", ()):
+            a = ActorInfo(rec["actor_id"], rec["spec"])
+            self._apply_actor_record(a, rec)
+            self._actors[a.actor_id] = a
+            name = a.spec["opts"].get("name") or ""
+            ns = a.spec["opts"].get("namespace") or "default"
+            if name and a.state != DEAD:
+                self._named_actors[(ns, name)] = a.actor_id
+        for rec in snap.get("pgs", ()):
+            pg = PlacementGroupInfo(rec["pg_id"], rec["bundles"],
+                                    rec["strategy"], rec["name"],
+                                    slice_topology=rec["slice_topology"])
+            pg.state = rec["state"]
+            pg.bundle_nodes = list(rec["bundle_nodes"])
+            pg.slice_id = rec["slice_id"]
+            self._pgs[pg.pg_id] = pg
+        self._kv.update(snap.get("kv", {}))
+        self._functions.update(snap.get("functions", {}))
+        self._job_counter = snap.get("job_counter", 0)
+
+    @staticmethod
+    def _apply_actor_record(a: "ActorInfo", rec: dict) -> None:
+        a.state = rec["state"]
+        a.address = rec["address"]
+        a.node_id = rec["node_id"]
+        a.num_restarts = rec["num_restarts"]
+        a.death_reason = rec["death_reason"]
+        a.incarnation = rec["incarnation"]
+
+    def _restore(self) -> None:
+        snap, records = self._journal.load()
+        if snap:
+            self._apply_snapshot(snap)
+        for kind, data in records:
+            try:
+                self._replay(kind, data)
+            except Exception:
+                continue
+        # Restored in-flight actors re-enter scheduling once nodes return.
+        pending = [a.actor_id for a in self._actors.values()
+                   if a.state in (PENDING_CREATION, RESTARTING)]
+        for actor_id in pending:
+            threading.Timer(0.5, self._schedule_actor, (actor_id,)).start()
+
+    def _replay(self, kind: str, data: dict) -> None:
+        now = time.monotonic()
+        if kind == "node":
+            info = dict(data)
+            info["last_heartbeat"] = now
+            self._nodes[info["node_id"]] = info
+        elif kind == "node_dead":
+            info = self._nodes.get(data["node_id"])
+            if info is not None:
+                info["alive"] = False
+        elif kind == "actor":
+            a = ActorInfo(data["actor_id"], data["spec"])
+            self._actors[a.actor_id] = a
+            name = a.spec["opts"].get("name") or ""
+            ns = a.spec["opts"].get("namespace") or "default"
+            if name:
+                self._named_actors[(ns, name)] = a.actor_id
+        elif kind == "actor_state":
+            a = self._actors.get(data["actor_id"])
+            if a is not None:
+                self._apply_actor_record(a, data)
+                if a.state == DEAD:
+                    self._drop_name(a)
+        elif kind == "pg":
+            pg = PlacementGroupInfo(
+                data["pg_id"], data["bundles"], data["strategy"],
+                data["name"], slice_topology=data["slice_topology"])
+            self._pgs[pg.pg_id] = pg
+        elif kind == "pg_state":
+            pg = self._pgs.get(data["pg_id"])
+            if pg is not None:
+                pg.state = data["state"]
+                pg.bundle_nodes = list(data["bundle_nodes"])
+                pg.slice_id = data["slice_id"]
+        elif kind == "pg_removed":
+            self._pgs.pop(data["pg_id"], None)
+        elif kind == "kv":
+            self._kv[(data["ns"], data["key"])] = data["value"]
+        elif kind == "kv_del":
+            self._kv.pop((data["ns"], data["key"]), None)
+        elif kind == "fn":
+            self._functions[data["function_id"]] = data["blob"]
+        elif kind == "job":
+            self._job_counter = data["counter"]
+
+    def _maybe_compact(self) -> None:
+        if not self._compact_due or self._journal is None:
+            return
+        self._compact_due = False
+        # Capture + truncate under the conductor lock: every _log() call
+        # site holds it, so no mutation can slip between the snapshot
+        # capture and the journal truncation (a frame landing in that
+        # window would be in neither file — silent durability loss).
+        with self._lock:
+            try:
+                self._journal.snapshot(self._durable_state())
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
     # Node membership + resource view (parity: GcsNodeManager + RaySyncer)
@@ -114,6 +289,8 @@ class Conductor:
                 "alive": True,
                 "last_heartbeat": time.monotonic(),
             }
+            self._log("node", {k: v for k, v in self._nodes[node_id].items()
+                               if k != "last_heartbeat"})
             self._cv.notify_all()
         # A new slice host may complete a gang a pending slice PG waits on.
         with self._lock:
@@ -121,7 +298,7 @@ class Conductor:
                        if pg.state == "PENDING"]
         for pg in pending:
             self._try_place_pg(pg)
-        return {"ok": True}
+        return {"ok": True, "epoch": self._epoch}
 
     # ------------------------------------------------------------------
     # TPU slice view (the differentiator: ICI-contiguous gang placement;
@@ -167,11 +344,12 @@ class Conductor:
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info["alive"]:
-                return {"ok": False, "reregister": True}
+                return {"ok": False, "reregister": True,
+                        "epoch": self._epoch}
             info["last_heartbeat"] = time.monotonic()
             info["resources_available"] = dict(resources_available)
             info["pending_demand"] = list(pending_demand or [])
-        return {"ok": True}
+        return {"ok": True, "epoch": self._epoch}
 
     def rpc_cluster_load(self) -> dict:
         """Autoscaler input (parity: the GCS load report monitor.py reads):
@@ -224,6 +402,7 @@ class Conductor:
     def _health_loop(self) -> None:
         while not self._stopped:
             time.sleep(self._health_timeout_s / 4)
+            self._maybe_compact()
             now = time.monotonic()
             dead = []
             with self._lock:
@@ -241,6 +420,7 @@ class Conductor:
             if info is None or not info["alive"]:
                 return
             info["alive"] = False
+            self._log("node_dead", {"node_id": node_id})
             # Drop its object locations; owners re-resolve and recover.
             for oid, locs in list(self._object_locations.items()):
                 locs.discard(node_id)
@@ -276,6 +456,7 @@ class Conductor:
             if not overwrite and (ns, key) in self._kv:
                 return False
             self._kv[(ns, key)] = value
+            self._log("kv", {"ns": ns, "key": key, "value": value})
             self._cv.notify_all()
         return True
 
@@ -294,6 +475,7 @@ class Conductor:
 
     def rpc_kv_del(self, ns: str, key: bytes) -> bool:
         with self._lock:
+            self._log("kv_del", {"ns": ns, "key": key})
             return self._kv.pop((ns, key), None) is not None
 
     def rpc_kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
@@ -303,6 +485,7 @@ class Conductor:
     def rpc_put_function(self, function_id: str, blob: bytes) -> None:
         with self._lock:
             self._functions[function_id] = blob
+            self._log("fn", {"function_id": function_id, "blob": blob})
 
     def rpc_get_function(self, function_id: str) -> Optional[bytes]:
         with self._lock:
@@ -322,6 +505,18 @@ class Conductor:
                     self._enqueue_delete(info["address"], oid)
                 return
             self._object_locations[oid].add(node_id)
+            self._cv.notify_all()
+
+    def rpc_add_object_locations(self, oids: List[bytes],
+                                 node_id: bytes) -> None:
+        """Bulk re-advertisement: a daemon that observes a new conductor
+        epoch replays its whole store inventory (the volatile half of
+        failover recovery; see persistence.py docstring)."""
+        with self._cv:
+            for oid in oids:
+                if oid in self._ref_tombstones:
+                    continue
+                self._object_locations[oid].add(node_id)
             self._cv.notify_all()
 
     def rpc_remove_object_location(self, oid: bytes, node_id: bytes) -> None:
@@ -386,13 +581,31 @@ class Conductor:
     # ------------------------------------------------------------------
     # Distributed refcounting (reference_count.h:61, centralized ledger)
     # ------------------------------------------------------------------
-    def rpc_ref_update(self, deltas: List[tuple]) -> None:
+    def rpc_ref_update(self, deltas: List[tuple],
+                       epoch: Optional[str] = None,
+                       batch_id: Optional[str] = None) -> dict:
         """Apply an ordered batch of count events from one process.
 
         Each event is ``(key, +1|-1)`` or ``(parent_key, [child_keys])``
         (the parent object contains refs to the children). Order within the
         batch is program order in the sender — applying sequentially is
-        what keeps handoffs race-free (see core/refcount.py docstring)."""
+        what keeps handoffs race-free (see core/refcount.py docstring).
+
+        ``epoch`` fences failover: deltas recorded against a dead
+        conductor's ledger are rejected with resync=True, and the tracker
+        replays its full local truth instead (refcount ledgers are
+        volatile; gcs_init_data.h reloads only durable tables)."""
+        if epoch is not None and epoch != self._epoch:
+            return {"epoch": self._epoch, "resync": True}
+        if batch_id is not None:
+            with self._lock:
+                if batch_id in self._ref_batches_seen:
+                    return {"epoch": self._epoch}  # at-least-once dedup
+                self._ref_batches_seen.add(batch_id)
+                self._ref_batch_order.append(batch_id)
+                while len(self._ref_batch_order) > 4096:
+                    self._ref_batches_seen.discard(
+                        self._ref_batch_order.popleft())
         to_free: List[bytes] = []
         with self._lock:
             stack = list(deltas)
@@ -424,6 +637,7 @@ class Conductor:
         if to_free:
             with self._cv:
                 self._cv.notify_all()
+        return {"epoch": self._epoch}
 
     def _collect_free(self, key: bytes) -> List[bytes]:
         """Free ``key`` and cascade to children whose counts hit zero.
@@ -502,6 +716,12 @@ class Conductor:
         name = spec["opts"].get("name") or ""
         ns = spec["opts"].get("namespace") or "default"
         with self._cv:
+            if actor_id in self._actors:
+                # At-least-once delivery (reconnecting client resent after
+                # a lost response): actor ids are caller-generated, so a
+                # duplicate IS the same creation — ack it, don't collide
+                # on the name.
+                return {"existing": None}
             if name:
                 existing = self._named_actors.get((ns, name))
                 if existing is not None and \
@@ -512,6 +732,7 @@ class Conductor:
                         f"Actor name {name!r} already taken in namespace {ns!r}")
                 self._named_actors[(ns, name)] = actor_id
             self._actors[actor_id] = ActorInfo(actor_id, spec)
+            self._log("actor", {"actor_id": actor_id, "spec": spec})
             self._cv.notify_all()
         self._schedule_actor(actor_id)
         return {"existing": None}
@@ -610,6 +831,7 @@ class Conductor:
             a.state = ALIVE
             a.address = address
             a.node_id = node_id
+            self._log("actor_state", self._actor_record(a))
             self._cv.notify_all()
 
     def rpc_actor_creation_failed(self, actor_id: bytes, incarnation: int,
@@ -622,6 +844,7 @@ class Conductor:
             a.death_reason = "creation failed"
             a.spec["creation_error"] = error_blob
             self._drop_name(a)
+            self._log("actor_state", self._actor_record(a))
             self._cv.notify_all()
 
     def rpc_report_actor_death(self, actor_id: bytes, reason: str,
@@ -648,6 +871,7 @@ class Conductor:
                 a.incarnation += 1
                 a.state = RESTARTING
                 a.address = None
+                self._log("actor_state", self._actor_record(a))
                 self._cv.notify_all()
                 restart = True
             else:
@@ -655,6 +879,7 @@ class Conductor:
                 a.death_reason = reason
                 a.address = None
                 self._drop_name(a)
+                self._log("actor_state", self._actor_record(a))
                 self._cv.notify_all()
                 restart = False
         if restart:
@@ -735,6 +960,9 @@ class Conductor:
                                 slice_topology=slice_topology)
         with self._lock:
             self._pgs[pg_id] = pg
+            self._log("pg", {"pg_id": pg_id, "bundles": bundles,
+                             "strategy": strategy, "name": name,
+                             "slice_topology": slice_topology})
         self._try_place_pg(pg)
 
     def _try_place_pg(self, pg: PlacementGroupInfo) -> None:
@@ -781,6 +1009,10 @@ class Conductor:
                     else:
                         pg.bundle_nodes = [n["node_id"] for n in plan]
                         pg.state = "CREATED"
+                        self._log("pg_state", {
+                            "pg_id": pg.pg_id, "state": pg.state,
+                            "bundle_nodes": pg.bundle_nodes,
+                            "slice_id": pg.slice_id})
                         self._cv.notify_all()
             if not ok or removed:
                 for _, addr, idx in prepared:
@@ -925,6 +1157,7 @@ class Conductor:
             if pg is None:
                 return
             pg.state = "REMOVED"
+            self._log("pg_removed", {"pg_id": pg_id})
             targets = [(self._nodes[n]["address"], i)
                        for i, n in enumerate(pg.bundle_nodes)
                        if n in self._nodes and self._nodes[n]["alive"]]
@@ -958,7 +1191,39 @@ class Conductor:
     def rpc_next_job_id(self) -> int:
         with self._lock:
             self._job_counter += 1
+            self._log("job", {"counter": self._job_counter})
             return self._job_counter
+
+    # ------------------------------------------------------------------
+    # Worker-log pubsub (parity: the log channel of src/ray/pubsub +
+    # python/ray/_private/log_monitor.py:104 — daemons tail worker files
+    # and publish; drivers long-poll and print)
+    # ------------------------------------------------------------------
+    def rpc_push_logs(self, lines: List[dict]) -> None:
+        with self._log_cv:
+            for line in lines:
+                self._log_seq += 1
+                self._log_buffer.append((self._log_seq, line))
+            self._log_cv.notify_all()
+
+    def rpc_poll_logs(self, after_seq: int, timeout: float = 0.0) -> dict:
+        deadline = time.monotonic() + timeout
+        with self._log_cv:
+            while True:
+                if self._log_seq > after_seq:
+                    # seqs are monotonic: walk back from the tail only as
+                    # far as needed instead of scanning the whole ring
+                    n = min(len(self._log_buffer),
+                            self._log_seq - after_seq)
+                    out = [l for s, l in list(self._log_buffer)[-n:]
+                           if s > after_seq]
+                    return {"lines": out, "seq": self._log_seq}
+                if timeout <= 0:
+                    return {"lines": [], "seq": self._log_seq}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"lines": [], "seq": self._log_seq}
+                self._log_cv.wait(min(remaining, 1.0))
 
     def rpc_ping(self) -> str:
         return "pong"
@@ -966,6 +1231,8 @@ class Conductor:
     def stop(self) -> None:
         self._stopped = True
         self.server.stop()
+        if self._journal is not None:
+            self._journal.close()
 
 
 def fits_and_take(avail: Dict[str, float], res: Dict[str, float]) -> bool:

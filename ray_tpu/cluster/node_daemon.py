@@ -107,18 +107,23 @@ class NodeDaemon:
         self._pending_demand: List[Dict[str, float]] = []
         self._infeasible_recent: Dict[tuple, float] = {}
         self._stopped = False
+        self._jobs: Dict[str, dict] = {}   # submission_id -> {proc, log, ...}
         self.server = RpcServer(self, host=host)
         self.address = self.server.address
-        get_client(conductor_address).call(
+        reg = get_client(conductor_address).call(
             "register_node", node_id=self.node_id, address=self.address,
             resources=self.total_resources, store_socket=self.store_socket,
             is_head=is_head, tpu_slice=self.tpu_slice)
+        self._conductor_epoch = (reg or {}).get("epoch")
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True, name="daemon-hb")
         self._hb_thread.start()
         self._reap_thread = threading.Thread(target=self._reap_loop,
                                              daemon=True, name="daemon-reap")
         self._reap_thread.start()
+        self._log_thread = threading.Thread(target=self._log_monitor_loop,
+                                            daemon=True, name="daemon-logs")
+        self._log_thread.start()
 
     # ------------------------------------------------------------------
     # heartbeat / membership
@@ -130,11 +135,33 @@ class NodeDaemon:
                 avail = dict(self._avail)
                 demand = [dict(d) for d in self._pending_demand]
             try:
-                cli.call("heartbeat", node_id=self.node_id,
-                         resources_available=avail,
-                         pending_demand=demand)
+                resp = cli.call("heartbeat", node_id=self.node_id,
+                                resources_available=avail,
+                                pending_demand=demand)
             except Exception:
-                pass
+                time.sleep(0.5)
+                continue
+            epoch = resp.get("epoch")
+            if resp.get("reregister") or (
+                    epoch is not None and epoch != self._conductor_epoch):
+                # Conductor restarted (new epoch) or lost us: re-register
+                # and re-advertise this node's volatile state — its store
+                # inventory (the object directory does not persist;
+                # persistence.py docstring).
+                try:
+                    reg = cli.call(
+                        "register_node", node_id=self.node_id,
+                        address=self.address,
+                        resources=self.total_resources,
+                        store_socket=self.store_socket,
+                        is_head=self.is_head, tpu_slice=self.tpu_slice)
+                    self._conductor_epoch = reg.get("epoch", epoch)
+                    oids = self.store.list_objects()
+                    if oids:
+                        cli.call("add_object_locations", oids=oids,
+                                 node_id=self.node_id)
+                except Exception:
+                    pass
             time.sleep(0.5)
 
     # ------------------------------------------------------------------
@@ -611,6 +638,141 @@ class NodeDaemon:
 
     def rpc_store_stats(self) -> dict:
         return self.store.stats()
+
+    # ------------------------------------------------------------------
+    # jobs (parity: dashboard/modules/job/job_manager.py:507 — the head
+    # node runs the entrypoint as a supervised subprocess; records live in
+    # the conductor KV so they survive failover)
+    # ------------------------------------------------------------------
+    def _job_update(self, submission_id: str, **fields) -> None:
+        import pickle
+        cli = get_client(self.conductor_address)
+        try:
+            blob = cli.call("kv_get", ns="_jobs", key=submission_id.encode())
+            rec = pickle.loads(blob) if blob else {"submission_id":
+                                                   submission_id}
+            rec.update(fields)
+            cli.call("kv_put", ns="_jobs", key=submission_id.encode(),
+                     value=pickle.dumps(rec))
+        except Exception:
+            pass
+
+    def rpc_start_job(self, submission_id: str, entrypoint: str,
+                      runtime_env: Optional[dict],
+                      conductor_address: str) -> dict:
+        log_path = os.path.join(self.session_dir,
+                                f"job-{submission_id}.log")
+        env = dict(os.environ)
+        env.update(self._env_vars)
+        env["RAY_TPU_ADDRESS"] = conductor_address
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if runtime_env and runtime_env.get("env_vars"):
+            env.update({str(k): str(v)
+                        for k, v in runtime_env["env_vars"].items()})
+        cwd = (runtime_env or {}).get("working_dir") or None
+        logf = open(log_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                ["/bin/sh", "-c", entrypoint], env=env, cwd=cwd,
+                stdout=logf, stderr=subprocess.STDOUT)
+        except OSError as e:
+            self._job_update(submission_id, status="FAILED",
+                             message=str(e), end_time=time.time())
+            return {"ok": False}
+        finally:
+            logf.close()  # the child holds its own dup of the fd
+        with self._lock:
+            self._jobs[submission_id] = {"proc": proc, "log": log_path,
+                                         "stopped": False}
+        self._job_update(submission_id, status="RUNNING",
+                         start_time=time.time())
+        threading.Thread(target=self._job_waiter, daemon=True,
+                         args=(submission_id, proc),
+                         name=f"job-{submission_id[:12]}").start()
+        return {"ok": True, "log_path": log_path}
+
+    def _job_waiter(self, submission_id: str, proc: subprocess.Popen) -> None:
+        code = proc.wait()
+        with self._lock:
+            stopped = self._jobs.get(submission_id, {}).get("stopped")
+        if stopped:
+            status, msg = "STOPPED", "stopped by user"
+        elif code == 0:
+            status, msg = "SUCCEEDED", ""
+        else:
+            status, msg = "FAILED", f"entrypoint exited with code {code}"
+        self._job_update(submission_id, status=status, message=msg,
+                         end_time=time.time())
+
+    def rpc_stop_job(self, submission_id: str) -> bool:
+        with self._lock:
+            job = self._jobs.get(submission_id)
+            if job is None:
+                return False
+            job["stopped"] = True
+        try:
+            job["proc"].terminate()
+        except OSError:
+            pass
+        return True
+
+    def rpc_job_log(self, submission_id: str, offset: int = 0,
+                    max_bytes: int = 1 << 20) -> dict:
+        with self._lock:
+            job = self._jobs.get(submission_id)
+        path = job["log"] if job else os.path.join(
+            self.session_dir, f"job-{submission_id}.log")
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(max_bytes)
+        except OSError:
+            data = b""
+        return {"data": data, "next_offset": offset + len(data)}
+
+    # ------------------------------------------------------------------
+    # worker-log tailer (parity: _private/log_monitor.py:104 — publish new
+    # worker stdout/stderr lines to the conductor's log channel)
+    # ------------------------------------------------------------------
+    def _log_monitor_loop(self) -> None:
+        import glob
+        offsets: Dict[str, int] = {}
+        cli = get_client(self.conductor_address)
+        while not self._stopped:
+            time.sleep(0.25)
+            batch: List[dict] = []
+            commits: List[tuple] = []   # (path, new_offset) — applied only
+            # after a successful publish, so failures re-read not drop
+            for path in glob.glob(os.path.join(self.session_dir,
+                                               "worker-*.out")):
+                try:
+                    size = os.path.getsize(path)
+                    off = offsets.get(path, 0)
+                    if size <= off:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        chunk = f.read(min(size - off, 1 << 20))
+                except OSError:
+                    continue  # this file vanished; others still ship
+                # ship whole lines only; carry partials forward
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    continue
+                pid = os.path.basename(path)[len("worker-"):-len(".out")]
+                for line in chunk[:cut].decode(errors="replace").splitlines():
+                    batch.append({"node": self.node_id.hex()[:8],
+                                  "worker": pid, "line": line})
+                commits.append((path, off + cut + 1))
+            if not batch:
+                continue
+            try:
+                for i in range(0, len(batch), 1000):
+                    cli.call("push_logs", lines=batch[i:i + 1000])
+            except Exception:
+                continue  # offsets not advanced: lines re-read next tick
+            for path, new_off in commits:
+                offsets[path] = new_off
 
     def rpc_ping(self) -> str:
         return "pong"

@@ -25,9 +25,12 @@ from ray_tpu.core.ids import ObjectID, store_key
 class ObjectPlane:
     def __init__(self, store: object_client.ShmClient, node_id: bytes,
                  conductor_address: str):
+        from ray_tpu import config
         self.store = store
         self.node_id = node_id
-        self.conductor = get_client(conductor_address)
+        self.conductor = get_client(
+            conductor_address,
+            reconnect_s=config.get("gcs_rpc_reconnect_s"))
         self._pull_locks: Dict[bytes, threading.Lock] = {}
         self._pull_guard = threading.Lock()
 

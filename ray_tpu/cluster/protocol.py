@@ -18,6 +18,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 
@@ -72,6 +73,12 @@ def _recv_frame(sock: socket.socket) -> bytes:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        self.server._conns.add(self.request)  # type: ignore[attr-defined]
+
+    def finish(self):
+        self.server._conns.discard(self.request)  # type: ignore[attr-defined]
+
     def handle(self):
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -110,6 +117,10 @@ class _Server(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+    def __init__(self, *args, **kwargs):
+        self._conns: set = set()
+        super().__init__(*args, **kwargs)
+
 
 class RpcServer:
     """Serves ``rpc_*`` methods of a service object on host:port.
@@ -134,16 +145,38 @@ class RpcServer:
             self._srv.server_close()
         except OSError:
             pass
+        # Sever live connections too: a handler thread parked on recv would
+        # otherwise keep serving this (dead) service's stale in-memory
+        # state to clients holding pooled sockets — fatal for failover,
+        # where a successor binds the same port.
+        for sock in list(self._srv._conns):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class RpcClient:
-    """Pooled client: one socket per concurrent caller to one address."""
+    """Pooled client: one socket per concurrent caller to one address.
 
-    def __init__(self, address: str, timeout: Optional[float] = None):
+    ``reconnect_s`` > 0 makes calls retry connection-level failures for up
+    to that many seconds — the failover transparency window (a restarted
+    conductor comes back on the same port; parity: the reference's GCS RPC
+    client reconnection, gcs_rpc_client.h). Retries are at-least-once:
+    non-idempotent services dedupe (e.g. ref_update batch ids).
+    """
+
+    def __init__(self, address: str, timeout: Optional[float] = None,
+                 reconnect_s: float = 0.0):
         self.address = address
         host, port = address.rsplit(":", 1)
         self._target = (host, int(port))
         self._timeout = timeout
+        self._reconnect_s = reconnect_s
         self._free: list = []
         self._lock = threading.Lock()
         self._closed = False
@@ -154,6 +187,20 @@ class RpcClient:
         return sock
 
     def call(self, method: str, _timeout: Optional[float] = None, **kwargs) -> Any:
+        deadline = (time.monotonic() + self._reconnect_s
+                    if self._reconnect_s > 0 else None)
+        while True:
+            try:
+                return self._call_once(method, _timeout, kwargs)
+            except (ConnectionLost, ConnectionRefusedError,
+                    ConnectionResetError, BrokenPipeError, OSError):
+                if deadline is None or time.monotonic() >= deadline or \
+                        self._closed:
+                    raise
+                time.sleep(0.1)
+
+    def _call_once(self, method: str, _timeout: Optional[float],
+                   kwargs: dict) -> Any:
         with self._lock:
             sock = self._free.pop() if self._free else None
         if sock is None:
@@ -192,17 +239,19 @@ class RpcClient:
                 pass
 
 
-_client_pool: Dict[Tuple[str, Optional[float]], RpcClient] = {}
+_client_pool: Dict[Tuple[str, Optional[float], float], RpcClient] = {}
 _client_pool_lock = threading.Lock()
 
 
-def get_client(address: str, timeout: Optional[float] = None) -> RpcClient:
+def get_client(address: str, timeout: Optional[float] = None,
+               reconnect_s: float = 0.0) -> RpcClient:
     """Process-wide client cache (parity: rpc/worker/core_worker_client_pool.h)."""
-    key = (address, timeout)
+    key = (address, timeout, reconnect_s)
     with _client_pool_lock:
         cli = _client_pool.get(key)
         if cli is None:
-            cli = RpcClient(address, timeout=timeout)
+            cli = RpcClient(address, timeout=timeout,
+                            reconnect_s=reconnect_s)
             _client_pool[key] = cli
         return cli
 

@@ -104,7 +104,10 @@ class WorkerService:
         fn = self._fn_cache.get(function_id)
         if fn is None:
             if blob is None:
-                blob = get_client(self.conductor_address).call(
+                from ray_tpu import config
+                blob = get_client(
+                    self.conductor_address,
+                    reconnect_s=config.get("gcs_rpc_reconnect_s")).call(
                     "get_function", function_id=function_id)
                 if blob is None:
                     raise RuntimeError(

@@ -130,6 +130,18 @@ define("testing_rpc_delay_us", str, "",
 
 # Transport
 define("rpc_connect_timeout_s", float, 10.0, "Client connect timeout.")
+define("gcs_rpc_reconnect_s", float, 5.0,
+       "Seconds drivers/planes retry conductor calls across a failover "
+       "window (0 disables; parity gcs_rpc_server_reconnect_timeout_s).")
+define("log_to_driver", bool, True,
+       "Stream worker stdout/stderr lines to connected drivers "
+       "(log_monitor.py role).")
+define("conductor_persist", bool, False,
+       "Journal durable conductor tables (gcs_table_storage.h role). Off "
+       "for ephemeral in-process heads (their temp session dir can't be "
+       "found again); `ray_tpu start --head` and explicit "
+       "Conductor(persist_dir=...) enable real restart recovery against a "
+       "stable path.")
 define("rpc_message_max_bytes", int, 512 * 1024 * 1024, "Max framed message size.")
 
 # TPU

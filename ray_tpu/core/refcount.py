@@ -47,6 +47,8 @@ class RefTracker:
         self._pins: Dict[bytes, int] = {}
         # ordered outbound events: (key16, ±1) or (key16, [child keys])
         self._events: List[Tuple[bytes, object]] = []
+        self._epoch: Optional[str] = None   # last seen conductor epoch
+        self._pending_batch: Optional[Tuple[str, list]] = None
         self._stopped = False
         self._flush_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -115,20 +117,79 @@ class RefTracker:
             self.flush()
 
     # -- flushing -------------------------------------------------------
+    def _snapshot_events(self) -> List[Tuple[bytes, object]]:
+        """This process's full current truth as +1 events (used to rebuild
+        the conductor's volatile ledger after failover). Caller holds
+        self._lock."""
+        snap: List[Tuple[bytes, object]] = []
+        for oid, c in self._local.items():
+            if c > 0:
+                snap.append((store_key(oid), 1))
+        for k, c in self._pins.items():
+            snap.extend([(k, 1)] * c)
+        return snap
+
     def flush(self) -> None:
         """Ship buffered events, preserving order. Safe to call from any
-        thread; the executing-worker ack path calls this synchronously."""
+        thread; the executing-worker ack path calls this synchronously.
+
+        Failover: each update carries the last seen conductor epoch. On an
+        epoch mismatch the conductor rejects (volatile ledger was lost) and
+        this tracker replays a snapshot of its full local truth instead —
+        buffered transitions are folded into that snapshot."""
         with self._flush_lock:  # one flusher at a time keeps the order
+            import uuid
+            # Retry a previously-failed batch under its ORIGINAL batch_id
+            # (the id is what makes at-least-once delivery idempotent: if
+            # the connection died after the conductor applied it, the
+            # resend is deduped server-side instead of double-counting).
+            if self._pending_batch is not None:
+                batch_id, events = self._pending_batch
+            else:
+                with self._lock:
+                    events, self._events = self._events, []
+                if not events:
+                    return
+                batch_id = uuid.uuid4().hex
             with self._lock:
-                events, self._events = self._events, []
-            if not events:
-                return
+                epoch = self._epoch
             try:
-                self._cli.call("ref_update", deltas=events)
+                resp = self._cli.call("ref_update", deltas=events,
+                                      epoch=epoch, batch_id=batch_id)
             except Exception:
-                # Conductor unreachable (shutdown / failover window). The
-                # store's LRU+spill is the backstop; do not crash refs.
-                pass
+                # Conductor unreachable (shutdown / failover window):
+                # retain the batch for the next attempt.
+                if len(events) <= 100_000:
+                    self._pending_batch = (batch_id, events)
+                return
+            self._pending_batch = None
+            if resp.get("resync"):
+                with self._lock:
+                    new_epoch = resp["epoch"]
+                    # ±1 transitions are already folded into the truth the
+                    # snapshot captures; children registrations are not —
+                    # carry them (from the rejected batch AND the buffer).
+                    children = [e for e in events + self._events
+                                if isinstance(e[1], list)]
+                    snap = self._snapshot_events() + children
+                    self._events = [e for e in self._events
+                                    if not isinstance(e[1], list)]
+                try:
+                    # batch_id: the reconnecting client retries at-least-
+                    # once; without dedup a lost response would double the
+                    # whole baseline. Epoch commits only AFTER the replay
+                    # lands — a failed replay re-resyncs next flush.
+                    self._cli.call("ref_update", deltas=snap,
+                                   epoch=new_epoch,
+                                   batch_id=uuid.uuid4().hex)
+                    with self._lock:
+                        self._epoch = new_epoch
+                except Exception:
+                    with self._lock:
+                        self._events = children + self._events
+            else:
+                with self._lock:
+                    self._epoch = resp.get("epoch")
 
     def _loop(self) -> None:
         while True:

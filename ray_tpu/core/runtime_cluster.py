@@ -590,19 +590,26 @@ class ClusterRuntime:
         self._owned_daemon = None
         if address is None:
             # Head mode: bring up the control plane + head node daemon.
+            import tempfile
             from ray_tpu.cluster.conductor import Conductor
             from ray_tpu.cluster.node_daemon import NodeDaemon
             total = self._default_resources(num_cpus, num_tpus, resources)
-            self._owned_conductor = Conductor()
+            session_dir = tempfile.mkdtemp(prefix="rtpu-session-")
+            self._owned_conductor = Conductor(
+                persist_dir=session_dir
+                if config.get("conductor_persist") else None)
             self.conductor_address = self._owned_conductor.address
             self._owned_daemon = NodeDaemon(
                 self.conductor_address, resources=total, is_head=True,
-                object_store_bytes=object_store_bytes)
+                object_store_bytes=object_store_bytes,
+                session_dir=session_dir)
             daemon = self._owned_daemon
         else:
             self.conductor_address = address
             daemon = None
-        self.conductor = get_client(self.conductor_address)
+        self.conductor = get_client(self.conductor_address,
+                                    reconnect_s=config.get(
+                                        "gcs_rpc_reconnect_s"))
         if daemon is None:
             daemon_info = self._find_local_daemon()
             if daemon_info is None:
@@ -661,8 +668,11 @@ class ClusterRuntime:
         self.caller_id = WorkerID.from_random().binary()
         self._owned_conductor = None
         self._owned_daemon = None
+        self._is_worker = True
         self.conductor_address = conductor_address
-        self.conductor = get_client(conductor_address)
+        self.conductor = get_client(conductor_address,
+                                    reconnect_s=config.get(
+                                        "gcs_rpc_reconnect_s"))
         self.daemon_address = daemon_address
         self.node_id = node_id
         self.store = store
@@ -686,6 +696,34 @@ class ClusterRuntime:
         from ray_tpu.core import refs as _refs_mod
         self._ref_tracker = refcount.RefTracker(self.conductor)
         _refs_mod._tracker = self._ref_tracker
+        # Worker stdout/stderr -> this driver (log_monitor.py role). Only
+        # true drivers subscribe: a worker echoing the channel into its own
+        # captured stdout would feed back into the channel.
+        self._log_stop = threading.Event()
+        if not getattr(self, "_is_worker", False) and \
+                config.get("log_to_driver"):
+            threading.Thread(target=self._log_subscriber, daemon=True,
+                             name="log-subscriber").start()
+
+    def _log_subscriber(self) -> None:
+        import sys
+        seq = None
+        while not self._log_stop.is_set():
+            try:
+                if seq is None:
+                    # start at the current tail: only NEW lines stream
+                    seq = self.conductor.call("poll_logs", after_seq=1 << 62,
+                                              timeout=0.0)["seq"]
+                resp = self.conductor.call("poll_logs", after_seq=seq,
+                                           timeout=1.0, _timeout=11.0)
+                seq = resp["seq"]
+                for line in resp["lines"]:
+                    print(f"({line.get('worker', '?')}, "
+                          f"node={line.get('node', '?')}) "
+                          f"{line.get('line', '')}", file=sys.stderr)
+            except Exception:
+                if self._log_stop.wait(0.5):
+                    return
 
     # ------------------------------------------------------------------
     # leases (used by TaskSubmitter)
@@ -1206,6 +1244,10 @@ class ClusterRuntime:
 
     def shutdown(self) -> None:
         from ray_tpu.core import refs as _refs_mod
+        try:
+            self._log_stop.set()
+        except AttributeError:
+            pass
         if _refs_mod._tracker is self._ref_tracker:
             _refs_mod._tracker = None
         try:

@@ -44,9 +44,14 @@ def cmd_start(args) -> None:
         resources["TPU"] = float(args.num_tpus)
     if args.head:
         from ray_tpu.cluster.conductor import Conductor
-        conductor = Conductor(host=args.host, port=args.port)
+        # Stable per-port session path: a head restarted on the same port
+        # finds its journal and recovers (gcs_init_data.h role).
+        session_dir = f"/tmp/ray_tpu/session-{args.port}"
+        os.makedirs(session_dir, exist_ok=True)
+        conductor = Conductor(host=args.host, port=args.port,
+                              persist_dir=session_dir)
         daemon = NodeDaemon(conductor.address, resources=resources,
-                            is_head=True,
+                            is_head=True, session_dir=session_dir,
                             object_store_bytes=args.object_store_memory
                             << 20)
         _write_state(conductor.address, [os.getpid(),
@@ -147,6 +152,43 @@ def cmd_microbenchmark(args) -> None:
     run_microbenchmark(address=getattr(args, "address", None))
 
 
+def cmd_job(args) -> None:
+    """`ray_tpu job submit/status/logs/list/stop` (parity: `ray job ...`,
+    dashboard/modules/job/cli.py)."""
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient(_resolve_address(args))
+    if args.job_cmd == "submit":
+        entry = list(args.entrypoint)
+        if entry and entry[0] == "--":
+            entry = entry[1:]
+        sid = client.submit_job(
+            entrypoint=" ".join(entry),
+            submission_id=args.submission_id or None,
+            runtime_env={"working_dir": args.working_dir}
+            if args.working_dir else None)
+        print(f"submitted job {sid}")
+        if args.follow:
+            for chunk in client.tail_job_logs(sid):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+            print(f"job {sid}: {client.get_job_status(sid)}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        if args.follow:
+            for chunk in client.tail_job_logs(args.submission_id):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+        else:
+            sys.stdout.write(client.get_job_logs(args.submission_id))
+    elif args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(f"{j.submission_id}  {j.status:10s}  {j.entrypoint}")
+    elif args.job_cmd == "stop":
+        ok = client.stop_job(args.submission_id)
+        print("stopped" if ok else "not running")
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(
         "ray_tpu", description="TPU-native distributed AI framework CLI")
@@ -175,6 +217,28 @@ def main(argv=None) -> None:
         if name == "timeline":
             p.add_argument("--output", default=None)
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser("job", help="submit and manage jobs")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    pj = jsub.add_parser("submit")
+    pj.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run, e.g. -- python train.py")
+    pj.add_argument("--address", default=None)
+    pj.add_argument("--submission-id", default=None)
+    pj.add_argument("--working-dir", default=None)
+    pj.add_argument("--follow", action="store_true",
+                    help="stream logs until the job finishes")
+    pj.set_defaults(fn=cmd_job)
+    for jname in ("status", "logs", "stop"):
+        pj = jsub.add_parser(jname)
+        pj.add_argument("submission_id")
+        pj.add_argument("--address", default=None)
+        if jname == "logs":
+            pj.add_argument("--follow", action="store_true")
+        pj.set_defaults(fn=cmd_job)
+    pj = jsub.add_parser("list")
+    pj.add_argument("--address", default=None)
+    pj.set_defaults(fn=cmd_job)
 
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument("entity", choices=["actors", "tasks", "nodes", "objects",

@@ -1,0 +1,99 @@
+"""Job submission + log streaming (job_manager.py:507 / log_monitor.py:104
+roles)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    yield c
+    c.shutdown()
+
+
+def test_job_submit_status_logs(cluster):
+    client = JobSubmissionClient(cluster.address)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job'); "
+                   f"print('line two')\"")
+    assert client.get_job_status(sid) in (JobStatus.PENDING,
+                                          JobStatus.RUNNING,
+                                          JobStatus.SUCCEEDED)
+    status = client.wait_until_finish(sid, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(sid)
+    assert "hello from job" in logs and "line two" in logs
+    jobs = client.list_jobs()
+    assert any(j.submission_id == sid for j in jobs)
+    info = client.get_job_info(sid)
+    assert info.status == JobStatus.SUCCEEDED
+
+
+def test_job_failure_reported(cluster):
+    client = JobSubmissionClient(cluster.address)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; "
+                   f"print('about to fail'); sys.exit(3)\"")
+    status = client.wait_until_finish(sid, timeout=60)
+    assert status == JobStatus.FAILED
+    assert "code 3" in client.get_job_info(sid).message
+    assert "about to fail" in client.get_job_logs(sid)
+
+
+def test_job_stop(cluster):
+    client = JobSubmissionClient(cluster.address)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(60)\"")
+    deadline = time.monotonic() + 30
+    while client.get_job_status(sid) != JobStatus.RUNNING:
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+    assert client.stop_job(sid)
+    assert client.wait_until_finish(sid, timeout=30) == JobStatus.STOPPED
+
+
+def test_job_tail_follow(cluster):
+    client = JobSubmissionClient(cluster.address)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -u -c \"import time\n"
+                   f"for i in range(5):\n"
+                   f"    print('tick', i, flush=True)\n"
+                   f"    time.sleep(0.1)\"")
+    chunks = list(client.tail_job_logs(sid))
+    text = "".join(chunks)
+    for i in range(5):
+        assert f"tick {i}" in text
+    assert client.get_job_status(sid) == JobStatus.SUCCEEDED
+
+
+def test_worker_logs_reach_conductor_channel(cluster):
+    """Daemons tail worker stdout and publish to the conductor's log
+    channel (the stream drivers subscribe to)."""
+    from ray_tpu.cluster.protocol import get_client
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        def chatty():
+            print("WORKER-SAYS-banana", flush=True)
+            return 1
+
+        assert ray_tpu.get(chatty.remote()) == 1
+        cli = get_client(cluster.address)
+        deadline = time.monotonic() + 15
+        seen = False
+        seq = 0
+        while time.monotonic() < deadline and not seen:
+            resp = cli.call("poll_logs", after_seq=seq, timeout=1.0)
+            seq = resp["seq"]
+            seen = any("WORKER-SAYS-banana" in l.get("line", "")
+                       for l in resp["lines"])
+        assert seen, "worker stdout line never reached the log channel"
+    finally:
+        ray_tpu.shutdown()
