@@ -257,7 +257,7 @@ class Conductor:
             self._job_counter = data["counter"]
 
     def _maybe_compact(self) -> None:
-        if not self._compact_due or self._journal is None:
+        if not self._compact_due or self._journal is None or self._stopped:
             return
         self._compact_due = False
         # Capture + truncate under the conductor lock: every _log() call

@@ -36,6 +36,7 @@ class _Worker:
         self.proc = proc
         self.token = token
         self.env_key = env_key
+        self.started_at = time.monotonic()
         self.worker_id: Optional[bytes] = None
         self.address: Optional[str] = None
         self.pid = proc.pid
@@ -105,6 +106,7 @@ class NodeDaemon:
         self._bundle_state: Dict[Tuple[bytes, int], str] = {}  # PREPARED|COMMITTED
         self._bundle_used: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self._pending_demand: List[Dict[str, float]] = []
+        self._pending_death_reports: List[dict] = []
         self._infeasible_recent: Dict[tuple, float] = {}
         self._stopped = False
         self._jobs: Dict[str, dict] = {}   # submission_id -> {proc, log, ...}
@@ -124,6 +126,50 @@ class NodeDaemon:
         self._log_thread = threading.Thread(target=self._log_monitor_loop,
                                             daemon=True, name="daemon-logs")
         self._log_thread.start()
+        # OOM protection (memory_monitor.h:52 + worker_killing_policy.h:34)
+        self._oom_monitor = None
+        self._last_oom_kill = 0.0
+        threshold = config.get("memory_usage_threshold")
+        if threshold > 0:
+            from ray_tpu.cluster import memory_monitor as mm
+            self._oom_monitor = mm.MemoryMonitor(
+                threshold, self._on_memory_pressure,
+                usage_fn=mm.system_memory_usage_fraction,
+                period_s=config.get("memory_monitor_refresh_ms") / 1000.0)
+
+    def _on_memory_pressure(self, usage: float) -> None:
+        """Kill one worker per pressure event (rate-limited): retriable
+        task workers first, newest first — the submitter's existing
+        fault-tolerance path retries the killed lease's tasks, which is
+        the whole point (die-with-retry beats the OS OOM killer taking
+        the daemon down)."""
+        from ray_tpu.cluster.memory_monitor import (WorkerKillingPolicy,
+                                                    process_rss_bytes)
+        now = time.monotonic()
+        if now - self._last_oom_kill < 1.0:
+            return
+        with self._lock:
+            candidates = [
+                {"pid": w.pid, "worker": w,
+                 "retriable": w.actor_id is None,
+                 "started_at": w.started_at}
+                for w in self._workers.values()
+                if w.lease_id is not None or w.actor_id is not None]
+        victim = WorkerKillingPolicy.pick(candidates)
+        if victim is None:
+            return
+        self._last_oom_kill = now
+        w = victim["worker"]
+        try:
+            get_client(self.conductor_address).call("push_logs", lines=[{
+                "node": self.node_id.hex()[:8], "worker": "daemon",
+                "line": f"OOM monitor: usage {usage:.2f} >= threshold; "
+                        f"killing worker pid={w.pid} "
+                        f"(rss={process_rss_bytes(w.pid) >> 20}MB, "
+                        f"retriable={victim['retriable']})"}])
+        except Exception:
+            pass
+        self._kill_worker(w)  # reaper reports lease/actor death
 
     # ------------------------------------------------------------------
     # heartbeat / membership
@@ -155,14 +201,32 @@ class NodeDaemon:
                         resources=self.total_resources,
                         store_socket=self.store_socket,
                         is_head=self.is_head, tpu_slice=self.tpu_slice)
-                    self._conductor_epoch = reg.get("epoch", epoch)
                     oids = self.store.list_objects()
                     if oids:
                         cli.call("add_object_locations", oids=oids,
                                  node_id=self.node_id)
+                    # Commit the epoch only once the WHOLE re-advertisement
+                    # landed — a half-failed attempt must re-run next beat.
+                    self._conductor_epoch = reg.get("epoch", epoch)
                 except Exception:
                     pass
+            self._flush_pending_death_reports(cli)
             time.sleep(0.5)
+
+    def _flush_pending_death_reports(self, cli) -> None:
+        """Actor-death reports that failed (conductor downtime) retry on
+        every heartbeat: with a persistent conductor a lost report would
+        otherwise leave a journal-restored actor ALIVE at a dead address
+        forever."""
+        with self._lock:
+            pending, self._pending_death_reports = \
+                self._pending_death_reports, []
+        for report in pending:
+            try:
+                cli.call("report_actor_death", **report)
+            except Exception:
+                with self._lock:
+                    self._pending_death_reports.append(report)
 
     # ------------------------------------------------------------------
     # worker pool (parity: worker_pool.h:156)
@@ -316,13 +380,18 @@ class NodeDaemon:
                 if w.lease_id is not None:
                     self._release_lease_resources(w)
                 if w.actor_id is not None:
+                    report = {
+                        "actor_id": w.actor_id,
+                        "reason": f"worker process died (exit {exit_code})",
+                        "incarnation": w.actor_incarnation,
+                    }
                     try:
                         get_client(self.conductor_address).call(
-                            "report_actor_death", actor_id=w.actor_id,
-                            reason=f"worker process died (exit {exit_code})",
-                            incarnation=w.actor_incarnation)
+                            "report_actor_death", **report)
                     except Exception:
-                        pass
+                        # conductor down: the heartbeat loop re-delivers
+                        with self._lock:
+                            self._pending_death_reports.append(report)
 
     # ------------------------------------------------------------------
     # leases (parity: HandleRequestWorkerLease node_manager.cc:1847)
@@ -780,6 +849,8 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     def stop(self) -> None:
         self._stopped = True
+        if self._oom_monitor is not None:
+            self._oom_monitor.stop()
         with self._lock:
             workers = list(self._workers.values())
             self._workers.clear()

@@ -22,6 +22,29 @@ from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
 from ray_tpu.core.ids import ObjectID, store_key
 
 
+class _ByteBudget:
+    """Admission control for concurrent pulls (pull_manager.h:52 role):
+    bounds total in-flight pull bytes so N parallel fetches of large
+    objects can't blow the local store. An oversized single request is
+    admitted alone (never deadlocks)."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._used = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int) -> None:
+        with self._cv:
+            while self._used > 0 and self._used + n > self.cap:
+                self._cv.wait(1.0)
+            self._used += n
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._used -= n
+            self._cv.notify_all()
+
+
 class ObjectPlane:
     def __init__(self, store: object_client.ShmClient, node_id: bytes,
                  conductor_address: str):
@@ -33,6 +56,8 @@ class ObjectPlane:
             reconnect_s=config.get("gcs_rpc_reconnect_s"))
         self._pull_locks: Dict[bytes, threading.Lock] = {}
         self._pull_guard = threading.Lock()
+        self._pull_budget = _ByteBudget(
+            config.get("max_concurrent_pull_bytes"))
 
     # -- write ----------------------------------------------------------
     def put_value(self, oid: ObjectID, value: Any) -> int:
@@ -136,11 +161,14 @@ class ObjectPlane:
             if self.store.contains(key):
                 return True
             cli = get_client(remote_addr)
+            admitted = 0
             try:
                 info = cli.call("object_info", oid=key)
                 if not info["found"]:
                     return False
                 size = info["size"]
+                self._pull_budget.acquire(size)
+                admitted = size
                 buf = self.store.create(key, size)
                 off = 0
                 while off < size:
@@ -155,6 +183,9 @@ class ObjectPlane:
                 raise
             except Exception:
                 return False
+            finally:
+                if admitted:
+                    self._pull_budget.release(admitted)
             self.conductor.call("add_object_location", oid=key,
                                 node_id=self.node_id)
             return True

@@ -56,6 +56,7 @@ class StateJournal:
         self._lock = threading.Lock()
         self._log_file = None
         self._appended = 0
+        self._closed = False
 
     # -- load -----------------------------------------------------------
     def load(self) -> Tuple[Optional[dict], List[Tuple[str, Any]]]:
@@ -76,6 +77,8 @@ class StateJournal:
         """Append one mutation. Returns True when a compaction is due."""
         frame = self._frame(kind, data)
         with self._lock:
+            if self._closed:
+                return False
             if self._log_file is None:
                 self._log_file = open(self.log_path, "ab")
             self._log_file.write(frame)
@@ -87,6 +90,10 @@ class StateJournal:
         """Write a full snapshot and truncate the journal."""
         tmp = self.snap_path + ".tmp"
         with self._lock:
+            if self._closed:
+                # a stopped conductor must never truncate files a same-dir
+                # successor may already be journaling into
+                return
             with open(tmp, "wb") as f:
                 f.write(self._frame("snapshot", state))
                 f.flush()
@@ -99,6 +106,7 @@ class StateJournal:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._log_file is not None:
                 try:
                     self._log_file.close()

@@ -103,6 +103,14 @@ define("object_store_eviction_watermark", float, 0.8,
 define("worker_pool_min_size", int, 0, "Workers prestarted per node at boot.")
 define("worker_pool_max_size", int, 8, "Max concurrent leased workers per node.")
 define("worker_idle_timeout_s", float, 60.0, "Idle worker reap timeout.")
+define("memory_usage_threshold", float, 0.95,
+       "Node memory fraction above which the daemon OOM-kills a worker "
+       "(memory_monitor.h:52 role; 0 disables).")
+define("memory_monitor_refresh_ms", int, 250,
+       "OOM monitor sampling period.")
+define("max_concurrent_pull_bytes", int, 256 * 1024 * 1024,
+       "Byte budget for concurrent remote-object pulls per process "
+       "(pull_manager.h:52 admission control role).")
 define("lease_reuse_enabled", bool, True,
        "Reuse a granted worker lease for queued tasks with the same scheduling "
        "key (the reference's lease-reuse fast path, direct_task_transport.cc).")

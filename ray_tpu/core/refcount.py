@@ -158,22 +158,35 @@ class RefTracker:
                                       epoch=epoch, batch_id=batch_id)
             except Exception:
                 # Conductor unreachable (shutdown / failover window):
-                # retain the batch for the next attempt.
+                # retain the batch for the next attempt. A batch too big
+                # to retain must NOT silently diverge the ledger — force a
+                # full resync instead (the sentinel never matches a real
+                # epoch, so the next flush is rejected into the resync
+                # path and replays this process's whole truth).
                 if len(events) <= 100_000:
                     self._pending_batch = (batch_id, events)
+                else:
+                    with self._lock:
+                        self._epoch = "force-resync"
+                        # children registrations aren't reconstructable
+                        # from local truth — keep those for the resync
+                        self._events = [e for e in events
+                                        if isinstance(e[1], list)] + \
+                            self._events
                 return
             self._pending_batch = None
             if resp.get("resync"):
                 with self._lock:
                     new_epoch = resp["epoch"]
-                    # ±1 transitions are already folded into the truth the
-                    # snapshot captures; children registrations are not —
-                    # carry them (from the rejected batch AND the buffer).
+                    # ±1 transitions (rejected batch AND buffer) are
+                    # already folded into the truth the snapshot captures —
+                    # clear them all, or they'd re-apply on the new epoch.
+                    # Children registrations are not part of the truth;
+                    # carry them explicitly.
                     children = [e for e in events + self._events
                                 if isinstance(e[1], list)]
                     snap = self._snapshot_events() + children
-                    self._events = [e for e in self._events
-                                    if not isinstance(e[1], list)]
+                    self._events = []
                 try:
                     # batch_id: the reconnecting client retries at-least-
                     # once; without dedup a lost response would double the
