@@ -70,6 +70,11 @@ class ScalingConfig:
 @dataclass
 class FailureConfig:
     max_failures: int = 0          # trial restarts on failure; -1 = infinite
+    # Elastic recovery (SURVEY §7 hard part): on gang failure, re-plan the
+    # worker count against the SURVIVING cluster — a smaller mesh resumes
+    # from the last checkpoint instead of waiting for the lost host.
+    elastic: bool = False
+    min_workers: int = 1           # floor for elastic shrink
     fail_fast: bool = False
 
 

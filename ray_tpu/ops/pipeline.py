@@ -68,7 +68,7 @@ def pipeline_apply_local(stage_fn: Callable, stage_params: Any, x,
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x, mesh: Mesh, *,
                    axis: str = "pp", num_microbatches: int = None,
                    params_stage_dim: int = 0,
-                   batch_axes=("dp", "fsdp")):
+                   batch_axes=("dcn_dp", "dp", "fsdp")):
     """shard_map-wrapped pipeline over `mesh`.
 
     stage_params: pytree whose leaves have a leading stage dim of size

@@ -76,7 +76,7 @@ def ring_attention_local(q, k, v, *, axis: str = "sp", causal: bool = True,
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
                    causal: bool = True, scale: Optional[float] = None,
-                   batch_axes=("dp", "fsdp")):
+                   batch_axes=("dcn_dp", "dp", "fsdp")):
     """shard_map-wrapped ring attention over `mesh`.
 
     q,k,v: global [B, S, H, D]; batch sharded over `batch_axes`, seq over

@@ -54,7 +54,7 @@ def ulysses_attention_local(q, k, v, *, axis: str = "sp",
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
                       causal: bool = True, scale: Optional[float] = None,
-                      batch_axes=("dp", "fsdp")):
+                      batch_axes=("dcn_dp", "dp", "fsdp")):
     """shard_map-wrapped Ulysses attention; q,k,v global [B, S, H, D]."""
     spec = P(tuple(a for a in batch_axes if a in mesh.axis_names),
              axis, None, None)

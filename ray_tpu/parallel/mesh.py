@@ -15,25 +15,41 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+AXIS_ORDER = ("dcn_dp", "pp", "dp", "fsdp", "sp", "ep", "tp")
 # tp innermost: tensor-parallel collectives are per-layer and latency-bound,
-# so tp must map to the fastest (most-adjacent) ICI dimension. pp outermost:
-# stage-to-stage transfers happen once per microbatch.
+# so tp must map to the fastest (most-adjacent) ICI dimension. pp outermost
+# within a slice: stage-to-stage transfers happen once per microbatch.
+# dcn_dp outermost of all: it is the ONLY axis allowed to cross slice
+# boundaries — pure data parallelism between slices, so the sole
+# inter-slice collective is the once-per-step gradient all-reduce, which is
+# the one communication pattern that tolerates DCN latency (multislice
+# recipe; the reference's nearest analog is multi-node NCCL DDP,
+# reference python/ray/train/torch/config.py:113).
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
-    """Axis sizes for the global device mesh. 1 = strategy off."""
+    """Axis sizes for the global device mesh. 1 = strategy off.
+
+    ``dcn_dp`` > 1 spans multiple TPU slices over DCN; all other axes must
+    fit within one slice (their collectives ride ICI).
+    """
     dp: int = 1
     fsdp: int = 1
     tp: int = 1
     pp: int = 1
     sp: int = 1
     ep: int = 1
+    dcn_dp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.pp * self.sp * self.ep
+        return (self.dp * self.fsdp * self.tp * self.pp * self.sp *
+                self.ep * self.dcn_dp)
+
+    @property
+    def devices_per_slice(self) -> int:
+        return self.num_devices // self.dcn_dp
 
     def axis_sizes(self) -> Dict[str, int]:
         return {a: getattr(self, a) for a in AXIS_ORDER}
@@ -43,18 +59,22 @@ class MeshSpec:
 
     @staticmethod
     def auto(num_devices: int, *, tp: int = 1, pp: int = 1, sp: int = 1,
-             ep: int = 1, fsdp: Optional[int] = None) -> "MeshSpec":
+             ep: int = 1, fsdp: Optional[int] = None,
+             dcn_dp: int = 1) -> "MeshSpec":
         """Fill the remaining devices with (fsdp or dp) parallelism."""
-        model = tp * pp * sp * ep
+        model = tp * pp * sp * ep * dcn_dp
         if num_devices % model:
             raise ValueError(
-                f"tp*pp*sp*ep={model} does not divide num_devices={num_devices}")
+                f"tp*pp*sp*ep*dcn_dp={model} does not divide "
+                f"num_devices={num_devices}")
         rest = num_devices // model
         if fsdp is None:
-            return MeshSpec(dp=rest, tp=tp, pp=pp, sp=sp, ep=ep)
+            return MeshSpec(dp=rest, tp=tp, pp=pp, sp=sp, ep=ep,
+                            dcn_dp=dcn_dp)
         if rest % fsdp:
             raise ValueError(f"fsdp={fsdp} does not divide remainder {rest}")
-        return MeshSpec(dp=rest // fsdp, fsdp=fsdp, tp=tp, pp=pp, sp=sp, ep=ep)
+        return MeshSpec(dp=rest // fsdp, fsdp=fsdp, tp=tp, pp=pp, sp=sp,
+                        ep=ep, dcn_dp=dcn_dp)
 
 
 def mesh_shape_for(spec: MeshSpec) -> Tuple[Tuple[str, int], ...]:
@@ -141,6 +161,34 @@ def _topology_ordered(devs: Sequence) -> Optional[List]:
     return out
 
 
+def _group_by_slice(devs: Sequence, num_slices: int) -> List[List]:
+    """Partition devices into per-slice groups for a dcn_dp mesh.
+
+    Real multislice TPU devices carry ``slice_index``; group by it. Virtual
+    or single-slice device sets (no/constant slice_index) are split evenly —
+    the dry-run/CPU stand-in for N slices.
+    """
+    by_idx: Dict[int, List] = {}
+    for d in devs:
+        idx = getattr(d, "slice_index", None)
+        if idx is None:
+            by_idx = {}
+            break
+        by_idx.setdefault(int(idx), []).append(d)
+    if by_idx:
+        # REAL slice membership: it must be consistent with the request —
+        # silently regrouping would lay ICI axes (tp/pp) across DCN.
+        groups = [by_idx[k] for k in sorted(by_idx)][:num_slices]
+        if len(by_idx) < num_slices or len({len(g) for g in groups}) != 1:
+            raise ValueError(
+                f"dcn_dp={num_slices} needs {num_slices} equal slices; "
+                f"devices report slice sizes "
+                f"{ {k: len(v) for k, v in sorted(by_idx.items())} }")
+        return groups
+    per = len(devs) // num_slices
+    return [list(devs[i * per:(i + 1) * per]) for i in range(num_slices)]
+
+
 def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None, *,
                topology_aware: bool = True):
     """Build a jax Mesh with the spec's axes over `devices`.
@@ -150,19 +198,39 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None, *,
     logical axis (tp — per-layer, latency-bound collectives) maps to
     ICI-adjacent chips and each outer axis to a physically contiguous
     block. Off-TPU (no coords) the jax device order is kept as-is.
+
+    dcn_dp > 1: devices are grouped per slice (``slice_index``), each
+    slice's block is topology-ordered independently, and the dcn_dp axis
+    strides across slices — so every intra-slice axis stays on ICI and only
+    the data axis crosses DCN.
     """
     import jax
     devs = list(devices) if devices is not None else list(jax.devices())
     if spec.num_devices > len(devs):
         raise ValueError(
             f"MeshSpec needs {spec.num_devices} devices, have {len(devs)}")
-    if topology_aware:
-        ordered = _topology_ordered(devs)
-        if ordered is not None:
-            devs = ordered
-    # Taking a prefix of the snake path keeps a physically contiguous
-    # sub-volume when the spec uses fewer devices than the slice has.
-    devs = devs[: spec.num_devices]
+    if spec.dcn_dp > 1:
+        groups = _group_by_slice(devs, spec.dcn_dp)
+        per_slice = spec.devices_per_slice
+        ordered_groups = []
+        for g in groups:
+            if len(g) < per_slice:
+                raise ValueError(
+                    f"dcn_dp={spec.dcn_dp} needs {per_slice} devices per "
+                    f"slice, a slice has {len(g)}")
+            if topology_aware:
+                og = _topology_ordered(g)
+                g = og if og is not None else list(g)
+            ordered_groups.append(g[:per_slice])
+        devs = [d for g in ordered_groups for d in g]
+    else:
+        if topology_aware:
+            ordered = _topology_ordered(devs)
+            if ordered is not None:
+                devs = ordered
+        # Taking a prefix of the snake path keeps a physically contiguous
+        # sub-volume when the spec uses fewer devices than the slice has.
+        devs = devs[: spec.num_devices]
     shape = [getattr(spec, a) for a in AXIS_ORDER]
     arr = np.array(devs, dtype=object).reshape(shape)
     return jax.sharding.Mesh(arr, AXIS_ORDER)
@@ -175,7 +243,7 @@ def local_mesh(**axis_sizes):
 
 def data_axes() -> Tuple[str, ...]:
     """Mesh axes a per-example batch dimension is sharded over."""
-    return ("dp", "fsdp")
+    return ("dcn_dp", "dp", "fsdp")
 
 
 def best_dp_fsdp_split(num_devices: int, params_bytes: int,

@@ -67,7 +67,7 @@ class LogicalRules:
 # on fsdp along their largest dim (ZeRO-3); tp splits heads/mlp/vocab;
 # sp shards the sequence dim; ep shards experts.
 DEFAULT_RULES = LogicalRules({
-    "batch": ("dp", "fsdp"),
+    "batch": ("dcn_dp", "dp", "fsdp"),
     "seq": "sp",
     "embed": "fsdp",
     "heads": "tp",

@@ -88,10 +88,13 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
 
     def start(self) -> None:
-        self.worker_group = WorkerGroup(
-            self.num_workers, self.resources_per_worker,
-            self.placement_strategy, slice_topology=self.slice_topology)
-        self.backend.on_start(self.worker_group)
+        try:
+            self.worker_group = WorkerGroup(
+                self.num_workers, self.resources_per_worker,
+                self.placement_strategy, slice_topology=self.slice_topology)
+            self.backend.on_start(self.worker_group)
+        except Exception as e:  # noqa: BLE001 - retryable via FailureConfig
+            raise TrainingFailedError(f"gang formation failed: {e!r}") from e
 
     def run(self, train_loop: Callable, config: dict,
             on_report: Callable[[dict], Any],
@@ -105,9 +108,12 @@ class BackendExecutor:
         """
         import ray_tpu as rt
         wg = self.worker_group
-        rt.get([w.start_training.remote(train_loop, config, trial_dir,
-                                        checkpoint)
-                for w in wg.workers], timeout=600)
+        try:
+            rt.get([w.start_training.remote(train_loop, config, trial_dir,
+                                            checkpoint)
+                    for w in wg.workers], timeout=600)
+        except Exception as e:  # noqa: BLE001 - gang infra failure
+            raise TrainingFailedError(f"gang start failed: {e!r}") from e
         history: List[dict] = []
         index = 0
         finished = False
@@ -118,8 +124,19 @@ class BackendExecutor:
             pending = set(range(len(wg.workers)))
             while pending:
                 for rank in list(pending):
-                    r = rt.get(wg.workers[rank].next_report.remote(
-                        index, 30.0), timeout=120)
+                    try:
+                        r = rt.get(wg.workers[rank].next_report.remote(
+                            index, 30.0), timeout=120)
+                    except TrainingFailedError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 - rank died
+                        # A dead rank (node loss, OOM kill) fails the whole
+                        # gang: an SPMD program cannot continue minus one
+                        # process — the trainer re-forms the gang (possibly
+                        # smaller, FailureConfig.elastic) from the last
+                        # checkpoint.
+                        raise TrainingFailedError(
+                            f"rank {rank} failed: {e!r}") from e
                     if r["status"] == "report":
                         round_reports[rank] = r
                         pending.discard(rank)

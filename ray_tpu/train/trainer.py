@@ -81,9 +81,10 @@ class DataParallelTrainer(BaseTrainer):
         stop = cfg.stop or {}
         failure = cfg.failure_config or FailureConfig()
         attempts = 0
+        num_workers = self.scaling_config.num_workers
         while True:
             executor = BackendExecutor(
-                self.backend, self.scaling_config.num_workers,
+                self.backend, num_workers,
                 self.scaling_config.worker_resources(),
                 self.scaling_config.placement_strategy,
                 slice_topology=self.scaling_config.topology)
@@ -129,8 +130,57 @@ class DataParallelTrainer(BaseTrainer):
                 # a lost host kills the XLA program; recovery = re-form
                 # the gang + checkpoint restore, not per-task retry)
                 self.resume_from_checkpoint = state["last_checkpoint"]
+                if failure.elastic:
+                    # Mesh-shrink: re-plan the gang against the SURVIVING
+                    # cluster. A smaller world_size resumes from the last
+                    # checkpoint now instead of parking on a lost host
+                    # (SURVEY §7 hard part: re-form a smaller mesh).
+                    num_workers = self._feasible_workers(
+                        num_workers, failure.min_workers)
             finally:
                 executor.shutdown()
+
+    def _feasible_workers(self, want: int, floor: int,
+                          settle_timeout: float = 30.0) -> int:
+        """How many workers the LIVE cluster can host right now. Waits
+        briefly for membership to settle (the dead node's health timeout)
+        whenever even ``floor`` workers don't fit yet."""
+        import math
+        import time as _time
+
+        import ray_tpu as rt
+        res = self.scaling_config.worker_resources()
+        deadline = _time.monotonic() + settle_timeout
+        from ray_tpu.cluster.protocol import get_client
+        while True:
+            slots = 0
+            assessable = False
+            try:
+                for n in rt.nodes():
+                    if not n["Alive"] or ":" not in str(n.get("address", "")):
+                        continue  # local-mode runtime: nothing to re-plan
+                    assessable = True
+                    # The conductor's health view lags a crash by its
+                    # timeout; a direct daemon ping settles liveness NOW (a
+                    # dead daemon refuses instantly; timeout=1.0 bounds the
+                    # CONNECT too, so a power-failed host can't park the
+                    # re-plan on the OS SYN-retry clock).
+                    try:
+                        get_client(n["address"], timeout=1.0).call(
+                            "ping", _timeout=1.0)
+                    except Exception:
+                        continue
+                    cap = min((n["Resources"].get(k, 0.0) / v
+                               for k, v in res.items() if v > 0),
+                              default=0.0)
+                    slots += int(math.floor(cap))
+            except Exception:
+                slots = 0
+            if not assessable:
+                return want
+            if slots >= floor or _time.monotonic() >= deadline:
+                return max(floor, min(want, slots))
+            _time.sleep(0.5)
 
 
 class JaxTrainer(DataParallelTrainer):

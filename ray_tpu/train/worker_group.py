@@ -128,20 +128,36 @@ class WorkerGroup:
                                       slice_topology=slice_topology)
         else:
             self.pg = placement_group(bundles, strategy=placement_strategy)
-        self.pg.ready(timeout=120)
-        cls = rt.remote(RayTrainWorker)
-        self.workers = []
-        for rank in range(num_workers):
-            strategy = PlacementGroupSchedulingStrategy(
-                self.pg, placement_group_bundle_index=rank)
-            w = cls.options(
-                num_cpus=resources_per_worker.get("CPU", 1.0),
-                num_tpus=resources_per_worker.get("TPU", 0.0),
-                resources={k: v for k, v in resources_per_worker.items()
-                           if k not in ("CPU", "TPU")},
-                scheduling_strategy=strategy,
-            ).remote(rank, num_workers, rank)
-            self.workers.append(w)
+        try:
+            self.pg.ready(timeout=120)
+            cls = rt.remote(RayTrainWorker)
+            self.workers = []
+            for rank in range(num_workers):
+                strategy = PlacementGroupSchedulingStrategy(
+                    self.pg, placement_group_bundle_index=rank)
+                w = cls.options(
+                    num_cpus=resources_per_worker.get("CPU", 1.0),
+                    num_tpus=resources_per_worker.get("TPU", 0.0),
+                    resources={k: v for k, v in resources_per_worker.items()
+                               if k not in ("CPU", "TPU")},
+                    scheduling_strategy=strategy,
+                ).remote(rank, num_workers, rank)
+                self.workers.append(w)
+        except BaseException:
+            # half-formed gang: kill any actors already created AND release
+            # the PG, so a retry plans against clean capacity (zombie ranks
+            # would double-book the bundles the conductor just returned)
+            from ray_tpu.util.placement_group import remove_placement_group
+            for w in getattr(self, "workers", []):
+                try:
+                    rt.kill(w)
+                except Exception:
+                    pass
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            raise
 
     def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
         import ray_tpu as rt
