@@ -1,0 +1,142 @@
+"""Scale-envelope benchmark: many nodes, many actors, deep task queues.
+
+Role parity: the reference's release benchmarks
+(release/benchmarks/README.md:5-12 — many_nodes, many_actors, many_tasks)
+scaled to one machine: daemons are in-process (their stores and workers are
+real processes), so this measures the CONTROL PLANE's envelope — conductor
+RPC latency under N heartbeating nodes, actor registration/creation
+throughput, and scheduling latency with a deep queue.
+
+Usage:
+    JAX_PLATFORMS=cpu python scale_bench.py [--round 3]
+        [--nodes 50] [--actors 100] [--tasks 10000]
+
+Writes SCALE_r{N}.json with --round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def pctl(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p / 100.0 * len(xs)))]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=0)
+    ap.add_argument("--nodes", type=int, default=50)
+    ap.add_argument("--actors", type=int, default=100)
+    ap.add_argument("--tasks", type=int, default=10000)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.cluster.protocol import get_client
+
+    results: dict = {"nodes": args.nodes, "actors": args.actors,
+                     "tasks": args.tasks}
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 16})
+    ray_tpu.init(address=c.address)
+    cli = get_client(c.address)
+    try:
+        # -- many nodes: register N zero-CPU daemons -------------------
+        t0 = time.perf_counter()
+        for _ in range(args.nodes):
+            c.add_node(num_cpus=0, object_store_bytes=32 << 20)
+        c.wait_for_nodes(args.nodes + 1, timeout=120)
+        results["node_register_per_sec"] = round(
+            args.nodes / (time.perf_counter() - t0), 1)
+
+        # control-plane RPC latency under N heartbeating nodes
+        lat = []
+        for i in range(200):
+            t0 = time.perf_counter()
+            cli.call("kv_put", ns="scale", key=f"k{i}".encode(), value=b"v")
+            lat.append(time.perf_counter() - t0)
+        results["kv_put_p50_ms"] = round(pctl(lat, 50) * 1e3, 2)
+        results["kv_put_p99_ms"] = round(pctl(lat, 99) * 1e3, 2)
+
+        lat = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            cli.call("get_nodes")
+            lat.append(time.perf_counter() - t0)
+        results["get_nodes_p50_ms"] = round(pctl(lat, 50) * 1e3, 2)
+        results["get_nodes_p99_ms"] = round(pctl(lat, 99) * 1e3, 2)
+
+        # -- deep queue: N tasks at once -------------------------------
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        ray_tpu.get([nop.remote() for _ in range(50)])  # warm leases
+        t0 = time.perf_counter()
+        refs = [nop.remote() for _ in range(args.tasks)]
+        submit_s = time.perf_counter() - t0
+        ray_tpu.get(refs, timeout=600)
+        total_s = time.perf_counter() - t0
+        results["task_submit_per_sec"] = round(args.tasks / submit_s, 1)
+        results["queued_tasks_drained_per_sec"] = round(
+            args.tasks / total_s, 1)
+
+        # control plane still responsive right after the storm
+        t0 = time.perf_counter()
+        cli.call("kv_put", ns="scale", key=b"after", value=b"v")
+        results["kv_put_after_storm_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+
+        # -- many actors: create in waves, one call each, kill ---------
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        created = []
+        t0 = time.perf_counter()
+        wave = 25
+        for start in range(0, args.actors, wave):
+            batch = [A.options(num_cpus=0.01).remote()
+                     for _ in range(min(wave, args.actors - start))]
+            ray_tpu.get([a.ping.remote() for a in batch], timeout=600)
+            created.extend(batch)
+        results["actor_create_call_per_sec"] = round(
+            len(created) / (time.perf_counter() - t0), 2)
+
+        # one broadcast round across every live actor
+        t0 = time.perf_counter()
+        ray_tpu.get([a.ping.remote() for a in created], timeout=600)
+        results["actor_broadcast_call_per_sec"] = round(
+            len(created) / (time.perf_counter() - t0), 1)
+        results["actors_alive"] = sum(
+            1 for a in cli.call("list_actors") if a["state"] == "ALIVE")
+        for a in created:
+            ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+    out = {"suite": "ray_tpu scale envelope",
+           "reference_analog": "release/benchmarks/README.md:5-12",
+           "results": results}
+    line = json.dumps(out, indent=2)
+    if args.round:
+        path = f"SCALE_r{args.round:02d}.json"
+        with open(path, "w") as f:
+            f.write(line + "\n")
+        print(f"wrote {path}")
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

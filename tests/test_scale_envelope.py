@@ -1,0 +1,85 @@
+"""Scale-envelope CI gate (reduced sizes of scale_bench.py; reference
+analog: release/benchmarks/README.md many_nodes/many_actors/many_tasks).
+Bounds assert the conductor's one-lock control plane doesn't degrade with
+cluster size — the full numbers live in SCALE_r{N}.json."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.cluster.protocol import get_client
+
+
+def _pctl(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p / 100.0 * len(xs)))]
+
+
+@pytest.fixture(scope="module")
+def big_cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    for _ in range(20):
+        c.add_node(num_cpus=0, object_store_bytes=32 << 20)
+    c.wait_for_nodes(21, timeout=120)
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_control_plane_latency_under_node_load(big_cluster):
+    """20 heartbeating nodes must not push conductor RPC p99 past 50ms."""
+    cli = get_client(big_cluster.address)
+    lat = []
+    for i in range(200):
+        t0 = time.perf_counter()
+        cli.call("kv_put", ns="scale", key=f"k{i}".encode(), value=b"v")
+        lat.append(time.perf_counter() - t0)
+    assert _pctl(lat, 99) < 0.05, f"kv_put p99 {_pctl(lat, 99)*1e3:.1f}ms"
+    lat = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        cli.call("get_nodes")
+        lat.append(time.perf_counter() - t0)
+    assert _pctl(lat, 99) < 0.05, f"get_nodes p99 {_pctl(lat, 99)*1e3:.1f}ms"
+
+
+def test_deep_queue_drains(big_cluster):
+    """2k tasks queued at once drain at a bounded rate and leave the
+    control plane responsive."""
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(20)])
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(2000)], timeout=300)
+    rate = 2000 / (time.perf_counter() - t0)
+    assert rate > 150, f"drain rate {rate:.0f}/s"
+    cli = get_client(big_cluster.address)
+    t0 = time.perf_counter()
+    cli.call("kv_put", ns="scale", key=b"after", value=b"v")
+    assert time.perf_counter() - t0 < 0.05
+
+
+def test_actor_wave(big_cluster):
+    """A wave of actors all come ALIVE and answer a broadcast."""
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    actors = []
+    for start in range(0, 30, 10):
+        batch = [A.options(num_cpus=0.01).remote() for _ in range(10)]
+        ray_tpu.get([a.ping.remote() for a in batch], timeout=300)
+        actors.extend(batch)
+    assert sum(ray_tpu.get([a.ping.remote() for a in actors],
+                           timeout=300)) == 30
+    cli = get_client(big_cluster.address)
+    alive = sum(1 for a in cli.call("list_actors") if a["state"] == "ALIVE")
+    assert alive >= 30
+    for a in actors:
+        ray_tpu.kill(a)
