@@ -25,8 +25,6 @@ import numpy as np
 from ray_tpu.data.block import (Block, BlockAccessor, block_from_rows,
                                 format_batch, normalize_batch_to_block)
 
-_MAX_INFLIGHT = 16   # streaming executor: concurrent transform tasks
-
 _remote_cache: Dict[Any, Any] = {}
 
 
@@ -153,60 +151,28 @@ def _groupby_agg_task(key: str, aggs: List[tuple], *blocks: Block) -> Block:
 # ---------------------------------------------------------------------------
 
 class _Op:
-    """One stage: turns an iterator of block refs into another."""
-
-    def apply(self, refs_iter: Iterator, submit) -> Iterator:
-        raise NotImplementedError
+    """Logical plan stage; lowered to a physical streaming operator
+    (data/streaming.py) at execution time."""
 
 
 class _OneToOneOp(_Op):
-    """Per-block task stage — streams with bounded in-flight."""
+    """Per-block task stage (lowered to streaming.MapOp)."""
 
     def __init__(self, task_fn, *args):
         self.task_fn = task_fn
         self.args = args
 
-    def apply(self, refs_iter, submit):
-        import ray_tpu as rt
-        from collections import deque
-        inflight: deque = deque()
-        for ref in refs_iter:
-            inflight.append(submit(self.task_fn, ref, *self.args))
-            while len(inflight) >= _MAX_INFLIGHT:
-                yield inflight.popleft()
-        while inflight:
-            yield inflight.popleft()
-
 
 class _AllToAllOp(_Op):
-    """Barrier stage (shuffle/repartition/sort): needs all input refs."""
+    """Barrier stage — shuffle/repartition/sort (streaming.AllToAllOp)."""
 
     def __init__(self, fn: Callable):
         self.fn = fn
-
-    def apply(self, refs_iter, submit):
-        refs = list(refs_iter)
-        return iter(self.fn(refs, submit))
 
 
 class _LimitOp(_Op):
     def __init__(self, n: int):
         self.n = n
-
-    def apply(self, refs_iter, submit):
-        import ray_tpu as rt
-        remaining = self.n
-        for ref in refs_iter:
-            if remaining <= 0:
-                return
-            block = rt.get(ref)
-            acc = BlockAccessor(block)
-            if acc.num_rows() <= remaining:
-                remaining -= acc.num_rows()
-                yield ref
-            else:
-                yield rt.put(acc.slice(0, remaining))
-                remaining = 0
 
 
 # ---------------------------------------------------------------------------
@@ -248,14 +214,17 @@ class Dataset:
         return self._with_op(_LimitOp(n))
 
     def repartition(self, num_blocks: int) -> "Dataset":
+        from ray_tpu.data.shuffle import push_based_shuffle
         return self._with_op(_AllToAllOp(
-            lambda refs, submit: _shuffle(refs, submit, num_blocks, None)))
+            lambda refs, submit: push_based_shuffle(refs, submit,
+                                                    num_blocks, None)))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        from ray_tpu.data.shuffle import push_based_shuffle
         seed = seed if seed is not None else np.random.randint(1 << 31)
         return self._with_op(_AllToAllOp(
-            lambda refs, submit: _shuffle(refs, submit,
-                                          max(1, len(refs)), seed)))
+            lambda refs, submit: push_based_shuffle(
+                refs, submit, max(1, len(refs)), seed)))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         def do_sort(refs, submit):
@@ -277,15 +246,30 @@ class Dataset:
     def _submit(self, task_fn, *args):
         return _remote_for(task_fn).remote(*args)
 
+    def _physical_ops(self):
+        from ray_tpu.data import streaming
+        phys = []
+        for op in self._ops:
+            if isinstance(op, _OneToOneOp):
+                phys.append(streaming.MapOp(op.task_fn, *op.args))
+            elif isinstance(op, _LimitOp):
+                phys.append(streaming.LimitOp(op.n))
+            elif isinstance(op, _AllToAllOp):
+                phys.append(streaming.AllToAllOp(op.fn))
+            else:
+                raise TypeError(f"unknown logical op {op!r}")
+        return phys
+
     def iter_block_refs(self) -> Iterator:
-        """Streaming execution: block refs flow through op stages with
-        bounded in-flight (parity: streaming_executor.py:45)."""
+        """Streaming execution: every operator runs concurrently with
+        bounded in-flight tasks and per-operator backpressure
+        (data/streaming.py; parity: streaming_executor.py:45)."""
         if self._materialized is not None:
             return iter(self._materialized)
-        it: Iterator = iter(self._source_refs)
-        for op in self._ops:
-            it = op.apply(it, self._submit)
-        return it
+        from ray_tpu.data.streaming import StreamingExecutor
+        return StreamingExecutor(self._physical_ops(),
+                                 list(self._source_refs),
+                                 self._submit).run()
 
     def materialize_refs(self) -> List[Any]:
         if self._materialized is None:
@@ -413,11 +397,11 @@ class Dataset:
                 f"pending_ops={len(self._ops)})")
 
 
-def _shuffle(refs: List[Any], submit, num_out: int,
-             seed: Optional[int]) -> List[Any]:
-    """Two-stage shuffle (parity: push_based_shuffle.py map/merge):
-    stage 1 splits each block into num_out shards; stage 2 merges shard i
-    of every block (+ local permutation when seeded)."""
+def _simple_shuffle(refs: List[Any], submit, num_out: int,
+                    seed: Optional[int]) -> List[Any]:
+    """Naive two-stage shuffle: every reduce waits for every map and takes
+    all M shards as one task's args. Kept as the baseline the push-based
+    shuffle (data/shuffle.py) is benchmarked against."""
     import ray_tpu as rt
     if not refs:
         return refs
