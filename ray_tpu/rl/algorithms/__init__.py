@@ -1,5 +1,7 @@
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rl.algorithms.impala import Impala, ImpalaConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 
-__all__ = ["PPO", "PPOConfig", "Impala", "ImpalaConfig", "DQN", "DQNConfig"]
+__all__ = ["PPO", "PPOConfig", "Impala", "ImpalaConfig", "DQN", "DQNConfig",
+           "SAC", "SACConfig"]

@@ -1,0 +1,122 @@
+"""SAC (discrete soft actor-critic) + offline IO / behavior cloning.
+
+Parity gates: rllib/algorithms/sac (learner-family algo on the shared
+RLModule/replay stack) and rllib/offline (JsonWriter/JsonReader + BC).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+def test_sac_learner_updates():
+    from ray_tpu.rl.algorithms.sac import SACLearner
+
+    learner = SACLearner({"obs_dim": 4, "num_actions": 2,
+                          "hiddens": (32, 32)}, seed=0)
+    rng = np.random.default_rng(0)
+    batch = SampleBatch({
+        sb.OBS: rng.normal(size=(64, 4)).astype(np.float32),
+        sb.ACTIONS: rng.integers(0, 2, 64),
+        sb.REWARDS: rng.normal(size=64).astype(np.float32),
+        sb.NEXT_OBS: rng.normal(size=(64, 4)).astype(np.float32),
+        sb.DONES: rng.integers(0, 2, 64).astype(np.float32),
+    })
+    s1 = learner.update(batch)
+    for _ in range(5):
+        s2 = learner.update(batch)
+    assert np.isfinite(s2["total_loss"])
+    assert s2["alpha"] > 0
+    # target networks track online Q (polyak) — they must have moved
+    import jax
+    diff = jax.tree_util.tree_reduce(
+        lambda a, b: a + b,
+        jax.tree_util.tree_map(
+            lambda t, o: float(np.abs(np.asarray(t) - np.asarray(o)).sum()),
+            learner.target, {"q1": learner.params["q1"],
+                             "q2": learner.params["q2"]}))
+    assert diff > 0
+
+
+def test_sac_cartpole_gate():
+    """Learning gate: SAC-Discrete reaches reward >= 100 on CartPole
+    within a CI-sized budget (rllib tuned-example gate, scaled)."""
+    from ray_tpu.rl.algorithms import SACConfig
+
+    config = (SACConfig().environment("CartPole-v1")
+              .rollouts(num_envs_per_worker=8,
+                        rollout_fragment_length=32))
+    config.seed = 0
+    algo = config.build()
+    best = 0.0
+    for i in range(40):
+        result = algo.train()
+        best = max(best, result.get("episode_reward_mean", 0.0) or 0.0)
+        if best >= 100:
+            break
+    assert best >= 100, f"SAC best reward {best} after {i + 1} iters"
+    # checkpoint roundtrip on the learner family
+    ckpt = algo.save()
+    algo2 = config.copy().build()
+    algo2.restore(ckpt)
+    import jax
+    same = jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: np.allclose(np.asarray(a), np.asarray(b)),
+        algo.learner.params, algo2.learner.params))
+    assert same
+    algo.stop()
+
+
+def test_json_writer_reader_roundtrip():
+    from ray_tpu.rl.offline import JsonReader, JsonWriter
+
+    path = tempfile.mkdtemp()
+    w = JsonWriter(path, max_rows_per_file=40)
+    rng = np.random.default_rng(1)
+    batch = SampleBatch({
+        sb.OBS: rng.normal(size=(100, 4)).astype(np.float32),
+        sb.ACTIONS: rng.integers(0, 2, 100),
+        sb.REWARDS: np.arange(100, dtype=np.float32),
+        sb.NEXT_OBS: rng.normal(size=(100, 4)).astype(np.float32),
+        sb.DONES: np.zeros(100),
+    })
+    w.write(batch)
+    w.close()
+    assert len(os.listdir(path)) >= 3  # sharded at 40 rows
+
+    r = JsonReader(path, shuffle=False)
+    assert len(r) == 100
+    back = r.read_all()
+    # rows survive the roundtrip (order within shards preserved)
+    assert sorted(np.asarray(back[sb.REWARDS]).tolist()) == \
+        list(range(100))
+    sample = r.sample(32)
+    assert sample.count == 32 and np.asarray(sample[sb.OBS]).shape == (32, 4)
+    batches = list(r.iter_batches(batch_size=30))
+    assert sum(b.count for b in batches) == 100
+
+
+def test_collect_and_behavior_clone():
+    """Offline pipeline end-to-end: collect an expert-ish dataset, clone
+    it with BC, and beat the random policy's return."""
+    from ray_tpu.rl.offline import BCConfig, collect_experiences
+
+    path = tempfile.mkdtemp()
+    # "expert": a simple pole-angle controller (good for ~100+ reward)
+    collect_experiences(
+        "CartPole-v1", path, num_steps=4000, seed=0,
+        policy_fn=lambda obs: (obs[:, 2] + 0.5 * obs[:, 3] > 0).astype(int))
+
+    bc = (BCConfig().offline_data(input_path=path)
+          .training(updates_per_iter=150, lr=3e-3)).build()
+    for _ in range(4):
+        stats = bc.train()
+    assert np.isfinite(stats["total_loss"])
+    ev = bc.evaluate(num_episodes=10)
+    assert ev["episode_reward_mean"] >= 60, (
+        f"cloned policy too weak: {ev}")
