@@ -300,24 +300,106 @@ def _fit_block(seq: int, want: int) -> int:
     return b if seq % b == 0 else seq
 
 
+# Per-generation default (block_q, block_k). v5e measured: 512x1024 is ~4x
+# the throughput of 128x128 (grid-step overhead amortizes over bigger MXU
+# work, 67 TF/s fwd at S=16k vs 10 TF/s). Larger-VMEM generations take a
+# wider kv block. autotune_blocks() refines these per (generation, seq)
+# on the live chip and its results take precedence.
+_GEN_BLOCKS = {
+    "v3": (256, 512),
+    "v4": (512, 1024),
+    "v5e": (512, 1024),
+    "v5p": (512, 1024),
+    "v6e": (512, 2048),
+}
+# (generation, seq, head_dim, causal) -> (block_q, block_k)
+_tuned_blocks: dict = {}
+
+
+def _generation() -> str:
+    from ray_tpu.tpu.topology import generation
+    return generation(default="v5e")
+
+
+def _default_blocks(seq_q: int, seq_k: int, head_dim: int, causal: bool):
+    gen = _generation()
+    want_q, want_k = _tuned_blocks.get(
+        (gen, seq_k, head_dim, causal), _GEN_BLOCKS.get(gen, (512, 1024)))
+    return _fit_block(seq_q, want_q), _fit_block(seq_k, want_k)
+
+
+def autotune_blocks(seq: int, *, head_dim: int = 128, heads: int = 8,
+                    batch: int = 2, causal: bool = True,
+                    candidates=None) -> tuple:
+    """Measure fwd+bwd flash throughput for candidate block shapes on the
+    LIVE chip and cache the winner for (generation, seq, head_dim, causal)
+    — the parameters block VMEM cost actually depends on.
+
+    One-time cost per shape (~seconds); subsequent flash_attention calls
+    with default blocks pick the tuned pair up automatically. No-op
+    (returns the static table entry) off-TPU.
+    """
+    import time as _time
+
+    gen = _generation()
+    key = (gen, seq, head_dim, causal)
+    if key in _tuned_blocks:
+        return _tuned_blocks[key]
+    if not _pallas_supported():
+        return _GEN_BLOCKS.get(gen, (512, 1024))
+    if candidates is None:
+        candidates = [(256, 512), (512, 512), (512, 1024), (512, 2048),
+                      (1024, 1024)]
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (batch, seq, heads, head_dim), jnp.bfloat16)
+    best, best_dt = None, float("inf")
+    for bq, bk in candidates:
+        if bq > seq or bk > seq:
+            continue
+
+        def run(q, bq=bq, bk=bk):
+            out = flash_attention(q, q, q, causal=causal,
+                                  block_q=_fit_block(seq, bq),
+                                  block_k=_fit_block(seq, bk))
+            return jnp.sum(out * out)
+
+        try:
+            g = jax.jit(jax.grad(run))
+            jax.block_until_ready(g(q))  # compile
+            t0 = _time.perf_counter()
+            for _ in range(3):
+                r = g(q)
+            jax.block_until_ready(r)
+            dt = _time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 - candidate doesn't fit VMEM
+            continue
+        if dt < best_dt:
+            best, best_dt = (bq, bk), dt
+    if best is not None:
+        _tuned_blocks[key] = best
+    return best or _GEN_BLOCKS.get(gen, (512, 1024))
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None):
     """Fused attention; q,k,v: [B, S, H, D] -> [B, Sq, H, D].
 
-    Default block sizes are tuned on v5e: 512x1024 is ~4x the throughput of
-    128x128 (grid-step overhead amortizes over bigger MXU work, measured
-    67 TF/s fwd at S=16k vs 10 TF/s at 128x128); blocks shrink to fit/divide
-    the sequence. Off-TPU backends fall back to the blockwise scan form
+    Default block sizes come from the per-generation table (refined by
+    autotune_blocks on the live chip); blocks shrink to fit/divide the
+    sequence. Off-TPU backends fall back to the blockwise scan form
     (identical math).
     """
     if not _pallas_supported():
         from ray_tpu.ops.attention import blockwise_attention
         return blockwise_attention(q, k, v, causal=causal, scale=scale,
                                    block_size=block_k or 128)
-    block_q = block_q if block_q is not None else _fit_block(q.shape[1], 512)
-    block_k = block_k if block_k is not None else _fit_block(k.shape[1], 1024)
+    if block_q is None or block_k is None:
+        dq, dk = _default_blocks(q.shape[1], k.shape[1], q.shape[-1],
+                                 causal)
+        block_q = block_q if block_q is not None else dq
+        block_k = block_k if block_k is not None else dk
     b, sq, h, d = q.shape
     _, sk, hk, _ = k.shape
     if hk != h:

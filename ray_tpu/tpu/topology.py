@@ -135,6 +135,23 @@ def device_kind() -> str:
     return devs[0].device_kind if devs else "none"
 
 
+def generation(default: str = "v5e") -> str:
+    """Cached TPU generation of the local devices ("v5e", "v4", ...).
+    The one shared entry point for generation-keyed tuning tables
+    (ops/flash block sizes, bench peak-FLOPs lookup)."""
+    global _generation_cache
+    if _generation_cache is None:
+        try:
+            gen = _generation_from_kind(device_kind())
+        except Exception:  # noqa: BLE001 - no backend at all
+            gen = default
+        _generation_cache = gen if gen not in ("cpu", "unknown") else default
+    return _generation_cache
+
+
+_generation_cache: Optional[str] = None
+
+
 def local_chip_count() -> int:
     import jax
     return jax.local_device_count()
