@@ -232,10 +232,8 @@ class NodeDaemon:
     # worker pool (parity: worker_pool.h:156)
     # ------------------------------------------------------------------
     def _env_key_of(self, runtime_env: Optional[dict]) -> str:
-        if not runtime_env:
-            return ""
-        import json
-        return json.dumps(runtime_env, sort_keys=True)
+        from ray_tpu.runtime_env import env_fingerprint
+        return env_fingerprint(runtime_env)
 
     def _spawn_worker(self, env_key: str,
                       runtime_env: Optional[dict]) -> _Worker:
@@ -245,6 +243,17 @@ class NodeDaemon:
         if runtime_env and runtime_env.get("env_vars"):
             env.update({str(k): str(v)
                         for k, v in runtime_env["env_vars"].items()})
+        if runtime_env and runtime_env.get("py_modules"):
+            # content-addressed unpack once per module version, then
+            # PYTHONPATH (runtime-env agent role, _private/runtime_env/)
+            from ray_tpu.runtime_env import unpack_py_modules
+            extra = unpack_py_modules(
+                runtime_env["py_modules"],
+                os.path.join(self.session_dir, "py_modules"))
+            if extra:
+                prev = env.get("PYTHONPATH", "")
+                env["PYTHONPATH"] = (extra + os.pathsep + prev) if prev \
+                    else extra
         # Worker subprocesses must not grab the TPU chip the trainer uses;
         # plain task workers run on CPU unless the lease says otherwise.
         env.setdefault("JAX_PLATFORMS", env.get("RTPU_WORKER_JAX_PLATFORMS",
@@ -738,6 +747,15 @@ class NodeDaemon:
         if runtime_env and runtime_env.get("env_vars"):
             env.update({str(k): str(v)
                         for k, v in runtime_env["env_vars"].items()})
+        if runtime_env and runtime_env.get("py_modules"):
+            from ray_tpu.runtime_env import unpack_py_modules
+            extra = unpack_py_modules(
+                runtime_env["py_modules"],
+                os.path.join(self.session_dir, "py_modules"))
+            if extra:
+                prev = env.get("PYTHONPATH", "")
+                env["PYTHONPATH"] = (extra + os.pathsep + prev) if prev \
+                    else extra
         cwd = (runtime_env or {}).get("working_dir") or None
         logf = open(log_path, "wb")
         try:

@@ -70,6 +70,9 @@ def make_task_options(base: Optional[TaskOptions] = None, **updates) -> TaskOpti
     if merged.num_returns < 0:
         raise ValueError("num_returns must be >= 0")
     _check_resources(merged)
+    if "runtime_env" in updates:
+        from ray_tpu.runtime_env import validate_runtime_env
+        merged.runtime_env = validate_runtime_env(merged.runtime_env)
     return merged
 
 
@@ -86,6 +89,9 @@ def make_actor_options(base: Optional[ActorOptions] = None, **updates) -> ActorO
     if merged.max_restarts < -1:
         raise ValueError("max_restarts must be >= -1 (-1 = infinite)")
     _check_resources(merged)
+    if "runtime_env" in updates:
+        from ray_tpu.runtime_env import validate_runtime_env
+        merged.runtime_env = validate_runtime_env(merged.runtime_env)
     return merged
 
 

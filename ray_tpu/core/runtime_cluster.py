@@ -41,6 +41,7 @@ from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.options import ActorOptions, TaskOptions
 from ray_tpu.core.refs import ObjectRef
 from ray_tpu.core.task_spec import FunctionDescriptor
+from ray_tpu.runtime_env import env_fingerprint as _env_fingerprint
 
 _LEASE_LINGER_S = 0.25     # idle lease kept briefly for reuse
 _MAX_LEASES_PER_KEY = 64
@@ -1039,7 +1040,7 @@ class ClusterRuntime:
             "return_oids": [task_id.object_id_for_return(i).binary()
                             for i in range(opts.num_returns)],
             "key": (desc.function_id, tuple(sorted(resources.items())),
-                    repr(strategy), repr(opts.runtime_env)),
+                    repr(strategy), _env_fingerprint(opts.runtime_env)),
         }
         self.submitter.submit(task)
         return [ObjectRef(task_id.object_id_for_return(i), owner=self.address)

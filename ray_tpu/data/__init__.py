@@ -12,8 +12,9 @@ from ray_tpu.data.datasource import (from_arrow, from_items, from_numpy,
                                      from_pandas, range, read_binary_files,
                                      read_csv, read_json, read_numpy,
                                      read_parquet)
+from ray_tpu.data import preprocessors
 
 __all__ = ["Dataset", "DatasetPipeline", "GroupedData", "Block",
            "BlockAccessor", "range", "from_items", "from_numpy",
            "from_pandas", "from_arrow", "read_parquet", "read_csv",
-           "read_json", "read_numpy", "read_binary_files"]
+           "read_json", "read_numpy", "read_binary_files", "preprocessors"]

@@ -76,6 +76,9 @@ class JobSubmissionClient:
                    runtime_env: Optional[dict] = None,
                    metadata: Optional[Dict[str, str]] = None) -> str:
         submission_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        if runtime_env is not None:
+            from ray_tpu.runtime_env import validate_runtime_env
+            runtime_env = validate_runtime_env(runtime_env)
         node = self._head_daemon()
         rec = {
             "submission_id": submission_id,

@@ -3,8 +3,11 @@
 Role parity: python/ray/runtime_env/runtime_env.py — a validated dict of
 environment customizations applied when the worker pool spawns a process
 for that env (node_daemon._spawn_worker): ``env_vars`` merge into the
-worker's environment, ``working_dir`` becomes its cwd. Workers are cached
-per runtime-env hash (the reference's dedicated-worker behavior).
+worker's environment, ``working_dir`` becomes its cwd, ``py_modules`` are
+packaged at validation time (zip, content-addressed) and unpacked onto the
+worker's PYTHONPATH on the executing node (the role of the reference's
+runtime-env agent + GCS package store, _private/runtime_env/py_modules.py).
+Workers are cached per runtime-env hash (dedicated-worker behavior).
 
 Unsupported-in-this-image plugins (pip/conda/container) raise upfront
 rather than failing inside the worker pool.
@@ -12,15 +15,93 @@ rather than failing inside the worker pool.
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import io
+import os
+import zipfile
 from typing import Any, Dict, List, Optional
 
-_SUPPORTED = {"env_vars", "working_dir"}
-_KNOWN_UNSUPPORTED = {"pip", "conda", "container", "py_modules"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_KNOWN_UNSUPPORTED = {"pip", "conda", "container"}
+_MAX_MODULE_ZIP = 64 << 20
+
+
+def _pack_module(path: str) -> Dict[str, str]:
+    """Zip one module (package dir or single .py) into a portable record.
+    Content-addressed so daemons extract each version exactly once."""
+    path = os.path.abspath(path)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        if os.path.isfile(path):
+            z.write(path, os.path.basename(path))
+        elif os.path.isdir(path):
+            base = os.path.basename(path.rstrip("/"))
+            for root, _dirs, files in os.walk(path):
+                for f in files:
+                    if f.endswith((".pyc", ".pyo")):
+                        continue
+                    full = os.path.join(root, f)
+                    z.write(full, os.path.join(
+                        base, os.path.relpath(full, path)))
+        else:
+            raise ValueError(f"py_module path {path!r} does not exist")
+    raw = buf.getvalue()
+    if len(raw) > _MAX_MODULE_ZIP:
+        raise ValueError(
+            f"py_module {path!r} packs to {len(raw)} bytes "
+            f"(limit {_MAX_MODULE_ZIP}); ship big deps in the image")
+    return {"name": os.path.basename(path),
+            "sha": hashlib.sha256(raw).hexdigest()[:16],
+            "zip_b64": base64.b64encode(raw).decode()}
+
+
+def unpack_py_modules(records: List[dict], dest_root: str) -> str:
+    """Daemon-side: extract packed modules under dest_root; returns the
+    PYTHONPATH entry to prepend. Idempotent per content hash, and safe
+    under concurrent spawns: extraction goes to a private temp dir that is
+    atomically renamed into place (a second extractor either loses the
+    rename race harmlessly or sees the finished directory)."""
+    import tempfile
+
+    paths = []
+    for rec in records:
+        out_dir = os.path.join(dest_root, rec["sha"])
+        if not os.path.isdir(out_dir):
+            os.makedirs(dest_root, exist_ok=True)
+            tmp = tempfile.mkdtemp(dir=dest_root,
+                                   prefix=f".{rec['sha']}-")
+            raw = base64.b64decode(rec["zip_b64"])
+            with zipfile.ZipFile(io.BytesIO(raw)) as z:
+                z.extractall(tmp)
+            try:
+                os.rename(tmp, out_dir)
+            except OSError:
+                import shutil
+                shutil.rmtree(tmp, ignore_errors=True)  # lost the race
+        paths.append(out_dir)
+    return os.pathsep.join(paths)
+
+
+def env_fingerprint(env: Optional[dict]) -> str:
+    """Stable, COMPACT identity for a runtime env: packed module blobs are
+    replaced by their content hashes so scheduling keys and worker-cache
+    keys never serialize megabytes of zip data."""
+    if not env:
+        return ""
+    import json
+    slim = dict(env)
+    if slim.get("py_modules"):
+        slim["py_modules"] = [
+            {"name": r.get("name"), "sha": r.get("sha")}
+            for r in slim["py_modules"]]
+    return json.dumps(slim, sort_keys=True, default=str)
 
 
 class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
-                 working_dir: Optional[str] = None, **kwargs):
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None, **kwargs):
         super().__init__()
         if env_vars is not None:
             if not all(isinstance(k, str) and isinstance(v, str)
@@ -28,11 +109,18 @@ class RuntimeEnv(dict):
                 raise TypeError("env_vars must be Dict[str, str]")
             self["env_vars"] = dict(env_vars)
         if working_dir is not None:
-            import os
             if not os.path.isdir(working_dir):
                 raise ValueError(
                     f"working_dir {working_dir!r} is not a directory")
             self["working_dir"] = working_dir
+        if py_modules is not None:
+            packed = []
+            for m in py_modules:
+                if isinstance(m, dict) and "zip_b64" in m:
+                    packed.append(dict(m))  # already packed (re-validation)
+                else:
+                    packed.append(_pack_module(str(m)))
+            self["py_modules"] = packed
         for k in kwargs:
             if k in _KNOWN_UNSUPPORTED:
                 raise ValueError(

@@ -57,6 +57,16 @@ def cmd_start(args) -> None:
         _write_state(conductor.address, [os.getpid(),
                                          daemon.store_proc.pid])
         print(f"ray_tpu head started. Address: {conductor.address}")
+        if args.dashboard_port >= 0:
+            from ray_tpu.dashboard import Dashboard
+            try:
+                dash = Dashboard(conductor.address, host=args.host,
+                                 port=args.dashboard_port)
+            except OSError:
+                # port taken (second head on one box): fall back to a
+                # random port rather than aborting head startup
+                dash = Dashboard(conductor.address, host=args.host, port=0)
+            print(f"Dashboard: {dash.url}")
         print(f"Connect other nodes with:\n  python -m ray_tpu start "
               f"--address {conductor.address}")
         print(f"Drive it with:\n  import ray_tpu; "
@@ -203,6 +213,8 @@ def main(argv=None) -> None:
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--object-store-memory", type=int, default=1024,
                    help="MB of shm for the object store")
+    p.add_argument("--dashboard-port", type=int, default=8265,
+                   help="dashboard HTTP port (0 = random, -1 = disabled)")
     p.add_argument("--block", action="store_true")
     p.set_defaults(fn=cmd_start)
 

@@ -6,6 +6,7 @@ TrialRunner/TuneController (execution/trial_runner.py:1179), search spaces
 schedulers/async_hyperband.py, median stopping, PBT pbt.py), ResultGrid.
 """
 
+from ray_tpu.tune.search import BasicVariantSearcher, Searcher, TPESearcher
 from ray_tpu.tune.search_space import (choice, grid_search, loguniform,
                                        randint, randn, uniform, sample_from)
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
@@ -16,6 +17,7 @@ from ray_tpu.tune.tuner import (ResultGrid, TuneConfig, Tuner, run)
 ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = ["Tuner", "TuneConfig", "ResultGrid", "run", "grid_search",
+           "Searcher", "BasicVariantSearcher", "TPESearcher",
            "choice", "uniform", "loguniform", "randint", "randn",
            "sample_from", "FIFOScheduler", "AsyncHyperBandScheduler",
            "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining"]

@@ -1,0 +1,177 @@
+"""Search algorithms: sequential config suggestion.
+
+Role parity: python/ray/tune/search/ — Searcher (searcher.py),
+BasicVariantGenerator (basic_variant.py), and the external-searcher role
+(hyperopt/optuna integrations) filled by a NATIVE TPE implementation
+(tree-structured Parzen estimator, the algorithm HyperOpt's default uses):
+no extra dependency, same adaptive behavior — after warmup it proposes
+configs that maximize l(x)/g(x), the density ratio of good-trial vs
+bad-trial parameter values.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.tune import search_space as ss
+
+
+class Searcher:
+    """suggest() next config or None when exhausted; observe completions."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict]) -> None:
+        pass
+
+
+class BasicVariantSearcher(Searcher):
+    """Pre-generated grid x random variants (basic_variant.py role)."""
+
+    def __init__(self, param_space: dict, num_samples: int, seed: int = 0,
+                 **kw):
+        super().__init__(**kw)
+        self._variants = ss.generate_variants(param_space, num_samples, seed)
+        self._i = 0
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg
+
+
+class TPESearcher(Searcher):
+    """Native tree-structured Parzen estimator over Domain params.
+
+    Grid params are not supported (use BasicVariantSearcher); constants
+    pass through. Numeric domains model good/bad observations with
+    gaussian kernels in the domain's native (possibly log) space;
+    categorical domains use smoothed good-trial frequencies.
+    """
+
+    def __init__(self, param_space: dict, num_samples: int,
+                 metric: str, mode: str = "max", *, seed: int = 0,
+                 n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24):
+        super().__init__(metric=metric, mode=mode)
+        self.space = dict(param_space)
+        for k, v in self.space.items():
+            if isinstance(v, ss._Grid) or (
+                    isinstance(v, dict) and "grid_search" in v):
+                raise ValueError(
+                    "TPESearcher does not take grid_search params; "
+                    "use the default variant generator for grids")
+        self.num_samples = num_samples
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._np = np.random.default_rng(seed)
+        self._suggested = 0
+        self._pending: Dict[str, dict] = {}
+        self._obs: List[tuple] = []   # (config, score)
+
+    # -- domain helpers --------------------------------------------------
+    @staticmethod
+    def _warp(dom, x):
+        return math.log(x) if isinstance(dom, ss._LogUniform) else float(x)
+
+    @staticmethod
+    def _unwarp(dom, u):
+        if isinstance(dom, ss._LogUniform):
+            return math.exp(u)
+        if isinstance(dom, ss._RandInt):
+            return int(round(u))
+        return float(u)
+
+    def _bounds(self, dom):
+        if isinstance(dom, ss._LogUniform):
+            return dom.lo, dom.hi
+        if isinstance(dom, ss._Uniform):
+            return dom.low, dom.high
+        if isinstance(dom, ss._RandInt):
+            return dom.low, dom.high - 1
+        return None
+
+    def _propose_numeric(self, dom, good: List[float], bad: List[float]):
+        lo, hi = self._bounds(dom)
+        width = (hi - lo) or 1.0
+        bw = max(width / max(len(good), 1) ** 0.5, width * 0.05)
+
+        def density(xs, centers):
+            if not centers:
+                return np.full(len(xs), 1.0 / width)
+            c = np.asarray(centers)[None, :]
+            x = np.asarray(xs)[:, None]
+            k = np.exp(-0.5 * ((x - c) / bw) ** 2) / (bw * math.sqrt(2 * math.pi))
+            return k.mean(axis=1) + 1e-12
+
+        # candidates drawn from the GOOD mixture (plus uniform exploration)
+        cands = []
+        for _ in range(self.n_candidates):
+            if good and self._rng.random() < 0.8:
+                cands.append(self._np.normal(self._rng.choice(good), bw))
+            else:
+                cands.append(self._rng.uniform(lo, hi))
+        cands = np.clip(np.asarray(cands), lo, hi)
+        score = density(cands, good) / density(cands, bad)
+        return float(cands[int(np.argmax(score))])
+
+    def _propose_choice(self, dom, good_vals: List[Any]):
+        opts = dom.options
+        counts = np.array([1.0 + sum(1 for g in good_vals if g == o)
+                           for o in opts])
+        return opts[int(self._np.choice(len(opts), p=counts / counts.sum()))]
+
+    # -- Searcher API -----------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        cfg: Dict[str, Any] = {}
+        warm = len(self._obs) >= self.n_initial
+        if warm:
+            ranked = sorted(self._obs, key=lambda t: -t[1])
+            n_good = max(1, int(self.gamma * len(ranked)))
+            good_cfgs = [c for c, _ in ranked[:n_good]]
+            bad_cfgs = [c for c, _ in ranked[n_good:]]
+        for k, v in self.space.items():
+            if not isinstance(v, ss.Domain):
+                cfg[k] = v
+            elif not warm:
+                cfg[k] = v.sample(self._rng)
+            elif isinstance(v, ss._Choice):
+                cfg[k] = self._propose_choice(
+                    v, [c[k] for c in good_cfgs])
+            elif self._bounds(v) is not None:
+                u = self._propose_numeric(
+                    v, [self._warp(v, c[k]) for c in good_cfgs],
+                    [self._warp(v, c[k]) for c in bad_cfgs])
+                cfg[k] = self._unwarp(v, u)
+            else:
+                cfg[k] = v.sample(self._rng)
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict]) -> None:
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or not result:
+            return
+        val = result.get(self.metric)
+        if val is None:
+            return
+        score = float(val) if self.mode == "max" else -float(val)
+        self._obs.append((cfg, score))

@@ -31,6 +31,10 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
+    # Sequential suggestion (tune/search/ role). None = pre-generated
+    # grid x random variants; a Searcher (e.g. TPESearcher) proposes each
+    # config from completed-trial results instead.
+    search_alg: Optional[Any] = None
     seed: int = 0
     resources_per_trial: Dict[str, float] = field(default_factory=dict)
 
@@ -186,8 +190,12 @@ class Tuner:
 
         import ray_tpu as rtp
         tc = self.tune_config
-        variants = generate_variants(self.param_space, tc.num_samples,
-                                     tc.seed)
+        if tc.search_alg is not None:
+            searcher = tc.search_alg
+        else:
+            from ray_tpu.tune.search import BasicVariantSearcher
+            searcher = BasicVariantSearcher(
+                self.param_space, tc.num_samples, tc.seed)
         exp_dir = os.path.join(
             self.run_config.storage_path or tempfile.gettempdir(),
             self.run_config.name or f"tune_{int(time.time())}")
@@ -201,23 +209,32 @@ class Tuner:
             num_cpus=res.get("CPU", 1.0), num_tpus=res.get("TPU", 0.0),
             resources={k: v for k, v in res.items()
                        if k not in ("CPU", "TPU")})
-        max_conc = tc.max_concurrent_trials or len(variants)
-        pending = []
+        # None = unbounded concurrency (the scheduler/leases throttle) —
+        # matches the pre-searcher behavior of launching every variant
+        max_conc = tc.max_concurrent_trials or (1 << 30)
         results: List[Result] = []
-        queue = list(enumerate(variants))
         inflight = {}
-        while queue or inflight:
-            while queue and len(inflight) < max_conc:
-                idx, cfg = queue.pop(0)
-                trial_id = f"trial_{idx:05d}"
+        next_idx = 0
+        exhausted = False
+        while not exhausted or inflight:
+            while not exhausted and len(inflight) < max_conc:
+                trial_id = f"trial_{next_idx:05d}"
+                cfg = searcher.suggest(trial_id)
+                if cfg is None:
+                    exhausted = True
+                    break
+                next_idx += 1
                 ref = run_remote.remote(
                     self._trainable, cfg, trial_id, board,
                     os.path.join(exp_dir, trial_id))
                 inflight[ref] = trial_id
+            if not inflight:
+                break
             ready, _ = rtp.wait(list(inflight), num_returns=1, timeout=600)
             for ref in ready:
-                inflight.pop(ref)
+                trial_id = inflight.pop(ref)
                 out = rtp.get(ref)
+                searcher.on_trial_complete(trial_id, out["metrics"])
                 results.append(Result(
                     metrics=out["metrics"], checkpoint=out["checkpoint"],
                     error=RuntimeError(out["error"]) if out["error"] else None,

@@ -1,0 +1,136 @@
+"""Dashboard HTTP surface (dashboard/head.py:71 role) and data
+preprocessors (python/ray/data/preprocessors parity)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.cluster.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_dashboard_endpoints(cluster):
+    from ray_tpu.dashboard import Dashboard
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    dash = Dashboard(cluster.address)
+    try:
+        # an actor and a job give the tables content
+        @ray_tpu.remote
+        class Marker:
+            def ping(self):
+                return 1
+
+        m = Marker.options(name="dash-marker").remote()
+        assert ray_tpu.get(m.ping.remote()) == 1
+        import sys
+        job = JobSubmissionClient(cluster.address)
+        sid = job.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('dash job')\"")
+        job.wait_until_finish(sid, timeout=60)
+
+        status, body = _get(dash.url + "/")
+        assert status == 200 and b"ray_tpu cluster" in body
+
+        status, body = _get(dash.url + "/api/cluster")
+        cl = json.loads(body)
+        assert cl["total"].get("CPU", 0) >= 8
+
+        status, body = _get(dash.url + "/api/nodes")
+        nodes = json.loads(body)
+        assert any(n["state"] == "ALIVE" for n in nodes)
+
+        status, body = _get(dash.url + "/api/actors")
+        actors = json.loads(body)
+        assert any(a.get("name") == "dash-marker" for a in actors)
+
+        status, body = _get(dash.url + "/api/jobs")
+        jobs = json.loads(body)
+        assert any(j["submission_id"] == sid and
+                   j["status"] == "SUCCEEDED" for j in jobs)
+
+        status, body = _get(dash.url + "/api/objects")
+        assert json.loads(body)  # at least the head node's store stats
+
+        status, body = _get(dash.url + "/metrics")
+        assert status == 200
+        ray_tpu.kill(m)
+    finally:
+        dash.stop()
+
+
+def test_standard_scaler(cluster):
+    from ray_tpu.data.preprocessors import StandardScaler
+
+    rng = np.random.default_rng(0)
+    vals = rng.normal(loc=5.0, scale=3.0, size=400)
+    ds = rd.from_items([{"x": float(v), "keep": i}
+                        for i, v in enumerate(vals)])
+    sc = StandardScaler(columns=["x"]).fit(ds)
+    assert abs(sc.stats_["x"]["mean"] - vals.mean()) < 1e-6
+    out = np.array([r["x"] for r in sc.transform(ds).take_all()])
+    assert abs(out.mean()) < 1e-6 and abs(out.std() - 1.0) < 1e-2
+    # non-listed columns untouched
+    assert sc.transform(ds).take(1)[0]["keep"] == 0
+
+
+def test_minmax_imputer_chain(cluster):
+    from ray_tpu.data.preprocessors import (Chain, MinMaxScaler,
+                                            SimpleImputer)
+
+    rows = [{"x": float(i)} for i in range(10)]
+    rows[3]["x"] = float("nan")
+    ds = rd.from_items(rows)
+    chain = Chain(SimpleImputer(columns=["x"]),
+                  MinMaxScaler(columns=["x"])).fit(ds)
+    out = [r["x"] for r in chain.transform(ds).take_all()]
+    assert min(out) == 0.0 and max(out) == 1.0
+    assert not any(np.isnan(out))
+    # serving-time single batch path
+    b = chain.transform_batch({"x": np.array([0.0, 9.0])})
+    assert b["x"][0] == 0.0 and b["x"][1] == 1.0
+
+
+def test_encoders_concatenator(cluster):
+    from ray_tpu.data.preprocessors import (Concatenator, LabelEncoder,
+                                            OneHotEncoder)
+
+    ds = rd.from_items([{"color": c, "v": float(i)}
+                        for i, c in enumerate(["r", "g", "b", "g", "r"])])
+    le = LabelEncoder("color").fit(ds)
+    assert le.classes_ == ["b", "g", "r"]
+    coded = [r["color"] for r in le.transform(ds).take_all()]
+    assert coded == [2, 1, 0, 1, 2]
+
+    oh = OneHotEncoder(columns=["color"]).fit(ds)
+    row = oh.transform(ds).take(1)[0]
+    assert row["color_r"] == 1 and row["color_g"] == 0
+
+    cat = Concatenator(columns=["v"], output_column="features")
+    feats = cat.transform(ds).take(2)
+    assert np.asarray(feats[0]["features"]).shape == (1,)
+
+
+def test_unfit_transform_raises(cluster):
+    from ray_tpu.data.preprocessors import StandardScaler
+
+    ds = rd.range(4)
+    with pytest.raises(RuntimeError, match="must be fit"):
+        StandardScaler(columns=["id"]).transform(ds)
