@@ -15,6 +15,8 @@ batched jitted forward — the serving analog of keeping the MXU fed.
 from ray_tpu.serve.api import (Application, Deployment, batch, delete,
                                deployment, get_deployment_handle, run,
                                shutdown, status)
+from ray_tpu.serve.http_proxy import StreamingResponse
 
 __all__ = ["deployment", "run", "delete", "shutdown", "status",
-           "get_deployment_handle", "batch", "Deployment", "Application"]
+           "get_deployment_handle", "batch", "Deployment", "Application",
+           "StreamingResponse"]

@@ -44,6 +44,11 @@ class DeploymentHandle:
     def options(self, method_name: str) -> "DeploymentHandle":
         return DeploymentHandle(self.name, method_name)
 
+    def __reduce__(self):
+        # Handles travel into replica __init__ args (DAG composition):
+        # rebuild fresh on the receiving worker (locks/caches don't ship).
+        return (DeploymentHandle, (self.name, self.method))
+
     def _refresh(self):
         import ray_tpu as rt
         with self._lock:
@@ -139,6 +144,11 @@ class Deployment:
         return d
 
     def bind(self, *args, **kwargs) -> "Application":
+        """Bind init args — which may include other bound Applications:
+        ``Ensemble.bind(ModelA.bind(), ModelB.bind())`` builds a deployment
+        GRAPH (parity: the serve DAG API, serve/api.py build/run). At
+        serve.run the graph deploys bottom-up and each nested Application
+        arrives in __init__ as a live DeploymentHandle."""
         d = self.options()
         d._init_args = (args, kwargs)
         return Application(d)
@@ -169,15 +179,36 @@ def deployment(target=None, *, name: Optional[str] = None, **config):
     return wrap
 
 
+def _deploy_graph(app: "Application") -> DeploymentHandle:
+    """Deploy an application graph bottom-up: nested bound Applications in
+    the init args deploy first and are replaced by their handles."""
+    d = app.deployment
+    args, kwargs = d._init_args
+
+    def resolve(v):
+        if isinstance(v, Application):
+            return _deploy_graph(v)
+        if isinstance(v, Deployment):
+            return _deploy_graph(v.bind())
+        if isinstance(v, (list, tuple)):
+            return type(v)(resolve(x) for x in v)
+        if isinstance(v, dict):
+            return {k: resolve(x) for k, x in v.items()}
+        return v
+
+    args = tuple(resolve(a) for a in args)
+    kwargs = {k: resolve(v) for k, v in kwargs.items()}
+    return d.deploy(*args, **kwargs)
+
+
 def run(app, *, http_host: Optional[str] = None,
         http_port: int = 0) -> DeploymentHandle:
-    """Deploy an Application (parity: serve.run)."""
+    """Deploy an Application (parity: serve.run), including DAGs built
+    with nested ``.bind()`` calls."""
     import ray_tpu as rt
     if isinstance(app, Deployment):
         app = app.bind()
-    d = app.deployment
-    args, kwargs = d._init_args
-    handle = d.deploy(*args, **kwargs)
+    handle = _deploy_graph(app)
     if http_host is not None:
         controller = _get_controller()
         port = rt.get(controller.start_http.remote(http_host, http_port),

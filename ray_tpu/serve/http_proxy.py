@@ -1,92 +1,216 @@
 """HTTP ingress for serve deployments.
 
 Role parity: serve/_private/http_proxy.py:250 — per-node proxy actor
-translating HTTP to deployment calls. The reference runs uvicorn/starlette;
-here a stdlib ThreadingHTTPServer inside the proxy actor keeps the image
-dependency-free. Routes come from the controller's route table; bodies are
-JSON (dict -> kwargs) or raw bytes.
+translating HTTP to deployment calls. The reference runs uvicorn/starlette
+(ASGI); here an asyncio HTTP/1.1 server keeps the image dependency-free
+while matching the ASGI proxy's operational shape: one event loop, many
+concurrent in-flight requests (each deployment call runs in an executor so
+the loop never blocks), keep-alive connections, and chunked
+Transfer-Encoding for streaming responses (serve.StreamingResponse).
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Iterable, Optional
+
+
+class StreamingResponse:
+    """Mark a deployment return value for chunked Transfer-Encoding: each
+    element of ``chunks`` is written as one HTTP chunk (str or bytes).
+
+    Delivery is chunked on the WIRE but materialized at the replica: the
+    chunk list rides the object store whole before the proxy writes it
+    (incremental token-by-token delivery would need per-chunk object refs
+    — a future generator-over-refs protocol)."""
+
+    def __init__(self, chunks: Iterable, content_type: str = "text/plain"):
+        self.chunks = list(chunks)
+        self.content_type = content_type
+
+    def __reduce__(self):
+        return (StreamingResponse, (self.chunks, self.content_type))
+
+
+def _http_error(code: int, msg: str) -> bytes:
+    body = json.dumps({"error": msg}).encode()
+    return (f"HTTP/1.1 {code} Error\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
 
 
 class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        proxy = self
+        self._routes_cache: dict = {}
+        self._routes_ts = 0.0
+        self._routes_lock = threading.Lock()
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._boot_error: Optional[BaseException] = None
+        self._host, self._want_port = host, port
+        self._port: Optional[int] = None
+        threading.Thread(target=self._run_loop, daemon=True,
+                         name="serve-proxy").start()
+        if not self._started.wait(10.0) or self._boot_error is not None:
+            raise self._boot_error or RuntimeError(
+                "serve proxy failed to start within 10s")
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
+    # -- event loop -------------------------------------------------------
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            server = await asyncio.start_server(
+                self._handle_conn, self._host, self._want_port)
+            self._port = server.sockets[0].getsockname()[1]
+
+        try:
+            self._loop.run_until_complete(boot())
+        except BaseException as e:  # noqa: BLE001 - re-raised in __init__
+            self._boot_error = e
+            self._started.set()
+            return
+        self._started.set()
+        self._loop.run_forever()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:  # keep-alive: serve requests until close/EOF
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, target, _version = \
+                        line.decode("latin1").split(" ", 2)
+                except ValueError:
+                    writer.write(_http_error(400, "bad request line"))
+                    await writer.drain()
+                    return
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                if "chunked" in headers.get("transfer-encoding", ""):
+                    # unsupported request framing: answer and CLOSE (the
+                    # unread chunk bytes would otherwise be parsed as the
+                    # next pipelined request)
+                    writer.write(_http_error(
+                        501, "chunked request bodies not supported"))
+                    await writer.drain()
+                    return
+                try:
+                    length = int(headers.get("content-length") or 0)
+                except ValueError:
+                    writer.write(_http_error(400, "bad Content-Length"))
+                    await writer.drain()
+                    return
+                body = await reader.readexactly(length) if length else b""
+                keep = headers.get("connection", "keep-alive") != "close"
+                await self._dispatch(method, target, body, writer)
+                await writer.drain()
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
                 pass
 
-            def _dispatch(self):
-                import ray_tpu as rt
-                from ray_tpu.serve.api import _handle_for
-                try:
-                    routes = proxy._routes()
-                    path = self.path.split("?")[0]
-                    name = None
-                    for prefix, dep in sorted(routes.items(),
-                                              key=lambda kv: -len(kv[0])):
-                        if path == prefix or path.startswith(
-                                prefix.rstrip("/") + "/"):
-                            name = dep
-                            break
-                    if name is None:
-                        self.send_response(404)
-                        self.end_headers()
-                        self.wfile.write(b'{"error": "no matching route"}')
-                        return
-                    length = int(self.headers.get("Content-Length") or 0)
-                    body = self.rfile.read(length) if length else b""
-                    args, kwargs = (), {}
-                    if body:
-                        try:
-                            payload = json.loads(body)
-                            if isinstance(payload, dict):
-                                kwargs = payload
-                            else:
-                                args = (payload,)
-                        except json.JSONDecodeError:
-                            args = (body,)
-                    handle = _handle_for(name)
-                    out = rt.get(handle.remote(*args, **kwargs),
-                                 timeout=120)
-                    data = json.dumps(out, default=str).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.end_headers()
-                    self.wfile.write(data)
-                except Exception as e:  # noqa: BLE001 - HTTP error surface
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(json.dumps(
-                        {"error": repr(e)}).encode())
+    async def _dispatch(self, method: str, target: str, body: bytes,
+                        writer: asyncio.StreamWriter) -> None:
+        path = target.split("?")[0]
 
-            do_GET = _dispatch
-            do_POST = _dispatch
+        def match(routes):
+            for prefix, dep in sorted(routes.items(),
+                                      key=lambda kv: -len(kv[0])):
+                if path == prefix or \
+                        path.startswith(prefix.rstrip("/") + "/"):
+                    return dep
+            return None
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever,
-                         daemon=True).start()
-        self._routes_cache = {}
-        self._routes_ts = 0.0
+        # route refresh is a controller RPC: executor offload keeps the
+        # loop free (same reason the deployment call below is offloaded)
+        routes = await self._loop.run_in_executor(None, self._routes)
+        name = match(routes)
+        if name is None:
+            # a just-deployed route may postdate the 1s cache: force ONE
+            # authoritative refresh before 404ing
+            routes = await self._loop.run_in_executor(
+                None, lambda: self._routes(force=True))
+            name = match(routes)
+        if name is None:
+            writer.write(_http_error(404, "no matching route"))
+            return
+        args, kwargs = (), {}
+        if body:
+            try:
+                payload = json.loads(body)
+                if isinstance(payload, dict):
+                    kwargs = payload
+                else:
+                    args = (payload,)
+            except json.JSONDecodeError:
+                args = (body,)
 
-    def _routes(self):
+        def call_blocking():
+            import ray_tpu as rt
+            from ray_tpu.serve.api import _handle_for
+            return rt.get(_handle_for(name).remote(*args, **kwargs),
+                          timeout=120)
+
+        try:
+            # executor offload: slow model calls never stall the loop —
+            # other connections keep being served (the ASGI property)
+            out = await self._loop.run_in_executor(None, call_blocking)
+        except Exception as e:  # noqa: BLE001 - HTTP error surface
+            writer.write(_http_error(500, repr(e)))
+            return
+        if isinstance(out, StreamingResponse):
+            writer.write((
+                "HTTP/1.1 200 OK\r\n"
+                f"Content-Type: {out.content_type}\r\n"
+                "Transfer-Encoding: chunked\r\n\r\n").encode())
+            for chunk in out.chunks:
+                data = chunk.encode() if isinstance(chunk, str) else \
+                    bytes(chunk)
+                if not data:
+                    continue
+                writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            return
+        data = json.dumps(out, default=str).encode()
+        writer.write((
+            "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n\r\n").encode() + data)
+
+    # -- control ----------------------------------------------------------
+    def _routes(self, force: bool = False):
         import time
+
         import ray_tpu as rt
         from ray_tpu.serve.controller import ServeController
-        if time.monotonic() - self._routes_ts > 1.0:
-            controller = rt.get_actor(ServeController.CONTROLLER_NAME)
-            self._routes_cache = rt.get(controller.get_routes.remote(),
-                                        timeout=30)
-            self._routes_ts = time.monotonic()
-        return self._routes_cache
+        with self._routes_lock:  # one refresher; others reuse its result
+            if force or time.monotonic() - self._routes_ts > 1.0:
+                # success OR failure advances the timestamp: a dead
+                # controller must not turn every request into a fresh
+                # blocking retry — stale routes serve the backoff window
+                self._routes_ts = time.monotonic()
+                try:
+                    controller = rt.get_actor(
+                        ServeController.CONTROLLER_NAME)
+                    self._routes_cache = rt.get(
+                        controller.get_routes.remote(), timeout=10)
+                except Exception:
+                    pass
+            return self._routes_cache
 
     def port(self) -> int:
         return self._port
