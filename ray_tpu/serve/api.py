@@ -179,17 +179,23 @@ def deployment(target=None, *, name: Optional[str] = None, **config):
     return wrap
 
 
-def _deploy_graph(app: "Application") -> DeploymentHandle:
+def _deploy_graph(app: "Application",
+                  _seen: Optional[dict] = None) -> DeploymentHandle:
     """Deploy an application graph bottom-up: nested bound Applications in
-    the init args deploy first and are replaced by their handles."""
+    the init args deploy first and are replaced by their handles. Shared
+    nodes (diamond DAGs) deploy exactly once (memoized by identity)."""
+    if _seen is None:
+        _seen = {}
+    if id(app) in _seen:
+        return _seen[id(app)]
     d = app.deployment
     args, kwargs = d._init_args
 
     def resolve(v):
         if isinstance(v, Application):
-            return _deploy_graph(v)
+            return _deploy_graph(v, _seen)
         if isinstance(v, Deployment):
-            return _deploy_graph(v.bind())
+            return _deploy_graph(v.bind(), _seen)
         if isinstance(v, (list, tuple)):
             return type(v)(resolve(x) for x in v)
         if isinstance(v, dict):
@@ -198,7 +204,9 @@ def _deploy_graph(app: "Application") -> DeploymentHandle:
 
     args = tuple(resolve(a) for a in args)
     kwargs = {k: resolve(v) for k, v in kwargs.items()}
-    return d.deploy(*args, **kwargs)
+    handle = d.deploy(*args, **kwargs)
+    _seen[id(app)] = handle
+    return handle
 
 
 def run(app, *, http_host: Optional[str] = None,

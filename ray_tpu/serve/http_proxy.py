@@ -42,9 +42,16 @@ def _http_error(code: int, msg: str) -> bytes:
 
 class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import concurrent.futures
         self._routes_cache: dict = {}
         self._routes_ts = 0.0
         self._routes_lock = threading.Lock()
+        self._routes_refreshing = False
+        self._fetch_future = None   # in-flight fetch shared by missers
+        # dedicated 1-thread executor for route refreshes: deployment
+        # calls saturating the default pool must never block routing
+        self._route_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-routes")
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
         self._boot_error: Optional[BaseException] = None
@@ -91,7 +98,9 @@ class HTTPProxy:
                 headers = {}
                 while True:
                     h = await reader.readline()
-                    if h in (b"\r\n", b"\n", b""):
+                    if h == b"":
+                        return  # EOF mid-headers: aborted request, drop it
+                    if h in (b"\r\n", b"\n"):
                         break
                     k, _, v = h.decode("latin1").partition(":")
                     headers[k.strip().lower()] = v.strip()
@@ -135,15 +144,18 @@ class HTTPProxy:
                     return dep
             return None
 
-        # route refresh is a controller RPC: executor offload keeps the
-        # loop free (same reason the deployment call below is offloaded)
-        routes = await self._loop.run_in_executor(None, self._routes)
+        # cache read is a plain dict lookup (safe on the loop thread);
+        # stale caches refresh in the dedicated route executor without
+        # blocking this request
+        routes = self._routes()
         name = match(routes)
         if name is None:
-            # a just-deployed route may postdate the 1s cache: force ONE
-            # authoritative refresh before 404ing
+            # a just-deployed route may postdate the cache: one
+            # authoritative refresh before 404ing. Coalesced single-flight:
+            # concurrent misses (or an unknown-path flood) share ONE
+            # controller RPC instead of amplifying per request.
             routes = await self._loop.run_in_executor(
-                None, lambda: self._routes(force=True))
+                None, self._fetch_routes_coalesced)
             name = match(routes)
         if name is None:
             writer.write(_http_error(404, "no matching route"))
@@ -192,24 +204,60 @@ class HTTPProxy:
             f"Content-Length: {len(data)}\r\n\r\n").encode() + data)
 
     # -- control ----------------------------------------------------------
-    def _routes(self, force: bool = False):
+    def _routes(self) -> dict:
+        """NON-BLOCKING cache read: returns the current table immediately;
+        a stale table kicks off (at most one) background refresh on the
+        dedicated route thread. Callers on the event loop never wait."""
+        import time
+        with self._routes_lock:
+            stale = time.monotonic() - self._routes_ts > 1.0
+            if stale and not self._routes_refreshing:
+                self._routes_refreshing = True
+                self._route_pool.submit(self._fetch_routes)
+            return self._routes_cache
+
+    def _fetch_routes(self) -> dict:
+        """Blocking controller fetch (runs on the route thread only)."""
         import time
 
         import ray_tpu as rt
         from ray_tpu.serve.controller import ServeController
-        with self._routes_lock:  # one refresher; others reuse its result
-            if force or time.monotonic() - self._routes_ts > 1.0:
-                # success OR failure advances the timestamp: a dead
-                # controller must not turn every request into a fresh
-                # blocking retry — stale routes serve the backoff window
+        try:
+            controller = rt.get_actor(ServeController.CONTROLLER_NAME)
+            fresh = rt.get(controller.get_routes.remote(), timeout=10)
+            with self._routes_lock:
+                self._routes_cache = fresh
+        except Exception:
+            pass  # keep serving the stale table
+        finally:
+            with self._routes_lock:
+                # success OR failure advances the clock: a dead controller
+                # backs off instead of retrying per request
                 self._routes_ts = time.monotonic()
-                try:
-                    controller = rt.get_actor(
-                        ServeController.CONTROLLER_NAME)
-                    self._routes_cache = rt.get(
-                        controller.get_routes.remote(), timeout=10)
-                except Exception:
-                    pass
+                self._routes_refreshing = False
+        return self._routes_cache
+
+    def _fetch_routes_coalesced(self) -> dict:
+        """Authoritative fetch with single-flight coalescing: callers that
+        arrive while a fetch is running wait for THAT fetch's result."""
+        created = False
+        with self._routes_lock:
+            fut = self._fetch_future
+            if fut is None:
+                fut = self._fetch_future = \
+                    self._route_pool.submit(self._fetch_routes)
+                created = True
+        if created:
+            # registered OUTSIDE the lock: a completed future runs the
+            # callback synchronously in this thread
+            def clear(_f):
+                with self._routes_lock:
+                    self._fetch_future = None
+
+            fut.add_done_callback(clear)
+        try:
+            return fut.result(timeout=15)
+        except Exception:
             return self._routes_cache
 
     def port(self) -> int:
