@@ -107,6 +107,7 @@ class NodeDaemon:
         self._bundle_used: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self._pending_demand: List[Dict[str, float]] = []
         self._pending_death_reports: List[dict] = []
+        self._prestarting = 0
         self._infeasible_recent: Dict[tuple, float] = {}
         self._stopped = False
         self._jobs: Dict[str, dict] = {}   # submission_id -> {proc, log, ...}
@@ -123,6 +124,9 @@ class NodeDaemon:
         self._reap_thread = threading.Thread(target=self._reap_loop,
                                              daemon=True, name="daemon-reap")
         self._reap_thread.start()
+        self._prestart_thread = threading.Thread(
+            target=self._prestart_loop, daemon=True, name="daemon-prestart")
+        self._prestart_thread.start()
         self._log_thread = threading.Thread(target=self._log_monitor_loop,
                                             daemon=True, name="daemon-logs")
         self._log_thread.start()
@@ -368,6 +372,51 @@ class NodeDaemon:
             w.proc.kill()
         except OSError:
             pass
+
+    def _prestart_loop(self) -> None:
+        """Prestart workers against lease backlog (parity:
+        node_manager.cc:1869 PrestartWorkers): while lease requests queue
+        on resources/spawns, warm spare workers concurrently so grants
+        don't serialize behind one-at-a-time process startup."""
+        while not self._stopped:
+            time.sleep(0.25)
+            with self._lock:
+                # Only FEASIBLE demand is backlog (infeasible shapes sit in
+                # _pending_demand for the autoscaler; warming workers for
+                # them would idle forever), and only the default-env pool
+                # is prestartable (runtime-env workers need the lease's
+                # env; the reference prestarts default workers the same
+                # way) — so compare against _idle[""] alone.
+                backlog = sum(
+                    1 for d in self._pending_demand
+                    if all(self.total_resources.get(k, 0.0) + 1e-9 >= v
+                           for k, v in d.items()))
+                idle = len(self._idle.get("", ()))
+                cap = min(config.get("worker_pool_max_size"),
+                          int(self.total_resources.get("CPU", 0)) or 1)
+                want = min(backlog - idle - self._prestarting,
+                           cap - len(self._workers))
+                if want > 0:
+                    self._prestarting += want
+            for _ in range(max(0, want)):
+                threading.Thread(target=self._prestart_one, daemon=True,
+                                 name="worker-prestart").start()
+
+    def _prestart_one(self) -> None:
+        try:
+            w = self._spawn_worker("", None)
+            if w.registered.wait(15.0) and w.proc.poll() is None:
+                with self._lock:
+                    self._idle.setdefault("", deque()).append(w.token)
+                with self._cv:
+                    self._cv.notify_all()
+            else:
+                self._kill_worker(w)
+        except Exception:
+            pass
+        finally:
+            with self._lock:
+                self._prestarting -= 1
 
     def _reap_loop(self) -> None:
         """Detect dead workers: fail their leases / report actor death."""

@@ -19,10 +19,12 @@ from ray_tpu.models.transformer import (
 )
 from ray_tpu.models.resnet import resnet50_init, resnet50_apply, resnet_loss
 from ray_tpu.models.mlp import mlp_init, mlp_apply
+from ray_tpu.models.vit import ViTConfig, vit_init, vit_apply, vit_loss
 
 __all__ = [
     "TransformerConfig", "transformer_init", "transformer_apply",
     "transformer_loss", "transformer_logical_axes",
     "resnet50_init", "resnet50_apply", "resnet_loss",
     "mlp_init", "mlp_apply",
+    "ViTConfig", "vit_init", "vit_apply", "vit_loss",
 ]

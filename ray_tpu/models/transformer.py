@@ -43,6 +43,7 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16             # compute dtype
     param_dtype: Any = jnp.float32
     attn_impl: str = "auto"               # auto|reference|blockwise|flash|ring|ulysses
+    causal: bool = True                   # False: bidirectional (ViT/BERT)
     remat: bool = True
     pp_stages: int = 1                    # >1: split layers into pipeline stages
     num_microbatches: int = 1             # pipeline microbatches
@@ -191,10 +192,10 @@ def _rope(x, positions, theta: float):
 def _attention(cfg: TransformerConfig, q, k, v, mesh):
     impl = cfg.attn_impl
     if impl == "ring":
-        return ring_attention(q, k, v, mesh, causal=True)
+        return ring_attention(q, k, v, mesh, causal=cfg.causal)
     if impl == "ulysses":
-        return ulysses_attention(q, k, v, mesh, causal=True)
-    return mha(q, k, v, causal=True, impl=impl)
+        return ulysses_attention(q, k, v, mesh, causal=cfg.causal)
+    return mha(q, k, v, causal=cfg.causal, impl=impl)
 
 
 def _layer_apply(cfg: TransformerConfig, mesh, layer, x, positions):

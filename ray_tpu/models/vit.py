@@ -1,0 +1,112 @@
+"""Vision Transformer — the image-classification transformer family.
+
+Role parity: the reference trains torchvision/timm ViTs through its train
+library; here ViT is built TPU-first from this repo's own transformer
+substrate: patchify is ONE einsum (an MXU matmul, not a conv), the encoder
+reuses TransformerConfig/_stage_apply — so every parallelism axis the LM
+stack supports (dp/fsdp/tp, remat, sharding rules) applies to ViT for
+free, including the Pallas flash-attention path for long patch sequences.
+
+Bidirectional attention (attn_impl='reference'/'blockwise' with
+causal=False semantics) is selected by the config below; classification
+reads a learned [CLS] token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import (TransformerConfig, _layer_init,
+                                        _rmsnorm, _stage_apply)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 384
+    n_layers: int = 6
+    n_heads: int = 6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + 1  # + [CLS]
+
+    def encoder_config(self) -> TransformerConfig:
+        """The shared transformer substrate, configured for vision:
+        full (non-causal) attention over patches, no RoPE influence from
+        the LM defaults beyond what positions encode."""
+        return TransformerConfig(
+            vocab_size=1, d_model=self.d_model, n_layers=self.n_layers,
+            n_heads=self.n_heads, max_seq=self.seq_len,
+            attn_impl="auto", causal=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, remat=self.remat)
+
+
+def vit_init(key, cfg: ViTConfig) -> Dict[str, Any]:
+    enc = cfg.encoder_config()
+    kp, kc, kpos, kh, klayers = jax.random.split(key, 5)
+    patch_dim = cfg.patch_size * cfg.patch_size * 3
+    pd = cfg.param_dtype
+    stacked = jax.vmap(lambda k: _layer_init(k, enc))(
+        jax.random.split(klayers, cfg.n_layers))
+    return {
+        "patch_proj": jax.random.normal(
+            kp, (patch_dim, cfg.d_model), pd) * (patch_dim ** -0.5),
+        "cls": jax.random.normal(kc, (1, 1, cfg.d_model), pd) * 0.02,
+        "pos": jax.random.normal(
+            kpos, (1, cfg.seq_len, cfg.d_model), pd) * 0.02,
+        "layers": stacked,
+        "ln_f": jnp.ones((cfg.d_model,), pd),
+        "head": jax.random.normal(
+            kh, (cfg.d_model, cfg.num_classes), pd) * (cfg.d_model ** -0.5),
+    }
+
+
+def _patchify(images, patch: int):
+    """[B, H, W, 3] -> [B, N, patch*patch*3] without a conv: reshape +
+    transpose keeps it a pure data-movement op; the projection matmul is
+    where the FLOPs go (MXU)."""
+    b, h, w, c = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def vit_apply(params, images, cfg: ViTConfig, *, mesh=None):
+    """images: [B, H, W, 3] float -> logits [B, num_classes]."""
+    enc = cfg.encoder_config()
+    dt = cfg.dtype
+    x = _patchify(images.astype(dt), cfg.patch_size)
+    x = x @ params["patch_proj"].astype(dt)
+    cls = jnp.broadcast_to(params["cls"].astype(dt),
+                           (x.shape[0], 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos"].astype(dt)
+    b, s = x.shape[:2]
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = _stage_apply(enc, mesh, params["layers"], x, positions)
+    x = _rmsnorm(x, params["ln_f"])
+    return (x[:, 0, :] @ params["head"].astype(dt)).astype(jnp.float32)
+
+
+def vit_loss(params, batch, cfg: ViTConfig, *, mesh=None):
+    logits = vit_apply(params, batch["image"], cfg, mesh=mesh)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, acc

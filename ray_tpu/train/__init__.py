@@ -11,6 +11,7 @@ from ray_tpu.train.jax_step import (
     TrainState,
     make_lm_train_step,
     make_resnet_train_step,
+    make_vit_train_step,
 )
 
 _LAZY = {
@@ -31,6 +32,7 @@ _LAZY = {
 }
 
 __all__ = ["TrainState", "make_lm_train_step", "make_resnet_train_step",
+           "make_vit_train_step",
            *_LAZY]
 
 

@@ -131,3 +131,53 @@ def make_resnet_train_step(mesh: Mesh, *, num_classes: int = 1000,
             batch)
 
     return init_fn, step_fn, place_batch
+
+
+def make_vit_train_step(cfg, mesh: Mesh, *,
+                        tx: Optional[optax.GradientTransformation] = None,
+                        learning_rate: float = 3e-4,
+                        rules: LogicalRules = DEFAULT_RULES):
+    """ViT train step on the shared transformer substrate: encoder layers
+    shard by the SAME logical-axis rules as the LM (fsdp/tp apply), batch
+    over the data axes; gradient psum inserted by XLA."""
+    from ray_tpu.models.vit import vit_init, vit_loss
+
+    if tx is None:
+        tx = optax.adamw(learning_rate, weight_decay=0.05)
+    enc = cfg.encoder_config()
+
+    def init_fn(key) -> TrainState:
+        params = vit_init(key, cfg)
+        layer_axes = transformer_logical_axes(enc)["layers"]
+        axes = {
+            "patch_proj": (None, "embed"),
+            "cls": (None, None, "embed"),
+            "pos": (None, None, "embed"),
+            "layers": layer_axes,
+            "ln_f": (None,),
+            "head": ("embed", None),
+        }
+        params = shard_pytree(params, mesh, axes, rules)
+        opt_state = jax.jit(tx.init)(params)
+        return TrainState(params, opt_state,
+                          jax.device_put(jnp.zeros((), jnp.int32),
+                                         replicated(mesh)))
+
+    def loss_fn(params, batch):
+        return vit_loss(params, batch, cfg, mesh=mesh)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state: TrainState, batch) -> Tuple[TrainState, dict]:
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, batch)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (TrainState(params, opt_state, state.step + 1),
+                {"loss": loss, "accuracy": acc})
+
+    def place_batch(batch):
+        return jax.tree.map(
+            lambda x: jax.device_put(x, batch_sharding(mesh, x.ndim, rules)),
+            batch)
+
+    return init_fn, step_fn, place_batch

@@ -20,7 +20,10 @@ def cluster():
     rt_ = ClusterRuntime(address=c.address)
     core_api._runtime = rt_
     yield c
-    serve.shutdown()
+    try:
+        serve.shutdown()
+    except Exception:
+        pass  # teardown must still release the global runtime
     core_api._runtime = None
     rt_.shutdown()
     c.shutdown()

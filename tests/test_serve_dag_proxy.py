@@ -14,6 +14,11 @@ from ray_tpu.cluster.cluster_utils import Cluster
 
 @pytest.fixture(scope="module")
 def cluster():
+    # a prior module's torn-down-but-leaked runtime must not block init
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
     c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
     ray_tpu.init(address=c.address)
     yield c

@@ -1,0 +1,100 @@
+"""ViT model family (shared transformer substrate) + worker
+prestart-on-backlog (node_manager.cc:1869 PrestartWorkers role)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_vit_forward_shapes_and_loss():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.vit import ViTConfig, vit_apply, vit_init, vit_loss
+
+    cfg = ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                    d_model=64, n_layers=2, n_heads=4, remat=False)
+    assert cfg.num_patches == 16 and cfg.seq_len == 17
+    params = vit_init(jax.random.PRNGKey(0), cfg)
+    imgs = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4, 32, 32, 3)), jnp.float32)
+    logits = jax.jit(lambda p, x: vit_apply(p, x, cfg))(params, imgs)
+    assert logits.shape == (4, 10) and logits.dtype == jnp.float32
+    loss, acc = vit_loss(params, {"image": imgs,
+                                  "label": jnp.array([1, 2, 3, 4])}, cfg)
+    assert np.isfinite(float(loss)) and 0.0 <= float(acc) <= 1.0
+
+
+def test_vit_train_step_learns_on_mesh():
+    """Sharded ViT training over the 8-device CPU mesh: loss decreases
+    (the encoder rides the LM's fsdp/tp sharding rules)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.vit import ViTConfig
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.train import make_vit_train_step
+
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2), jax.devices()[:8])
+    cfg = ViTConfig(image_size=16, patch_size=8, num_classes=4,
+                    d_model=64, n_layers=2, n_heads=4, remat=False)
+    init_fn, step_fn, place_batch = make_vit_train_step(
+        cfg, mesh, learning_rate=3e-3)
+    state = init_fn(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 16)
+    # learnable signal: class k images have mean shifted by k
+    images = rng.normal(size=(16, 16, 16, 3)) * 0.1 + \
+        labels[:, None, None, None]
+    batch = place_batch({"image": jnp.asarray(images, jnp.float32),
+                         "label": jnp.asarray(labels, jnp.int32)})
+    first = None
+    for _ in range(80):
+        state, metrics = step_fn(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert last < first * 0.65, f"ViT did not learn: {first} -> {last}"
+    # fsdp actually shards encoder weights
+    wq = state.params["layers"]["attn"]["wq"]
+    assert "fsdp" in str(wq.sharding.spec) or "tp" in str(wq.sharding.spec)
+
+
+def test_prestart_spawns_against_backlog():
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    daemon = c.nodes[0]
+    ray_tpu.init(address=c.address)
+    try:
+        # sustained backlog: more demand entries than idle workers
+        with daemon._lock:
+            daemon._pending_demand.extend({"CPU": 1.0} for _ in range(4))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with daemon._lock:
+                idle = sum(len(q) for q in daemon._idle.values())
+            if idle >= 2:
+                break
+            time.sleep(0.2)
+        assert idle >= 2, "prestart never warmed workers against backlog"
+        with daemon._lock:
+            daemon._pending_demand.clear()
+        # prestarted workers are real: a task checks one out and runs
+        with daemon._lock:
+            workers_before = len(daemon._workers)
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote(), timeout=30) == 1
+        with daemon._lock:
+            workers_after = len(daemon._workers)
+        assert workers_after <= workers_before  # no extra cold spawn
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
