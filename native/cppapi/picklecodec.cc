@@ -1,0 +1,441 @@
+#include "picklecodec.hpp"
+
+#include <cstring>
+
+namespace raytpu {
+namespace {
+
+// Pickle opcodes (CPython Lib/pickletools.py names).
+constexpr char OP_PROTO = '\x80';
+constexpr char OP_FRAME = '\x95';
+constexpr char OP_STOP = '.';
+constexpr char OP_NONE = 'N';
+constexpr char OP_NEWTRUE = '\x88';
+constexpr char OP_NEWFALSE = '\x89';
+constexpr char OP_BININT = 'J';
+constexpr char OP_BININT1 = 'K';
+constexpr char OP_BININT2 = 'M';
+constexpr char OP_LONG1 = '\x8a';
+constexpr char OP_BINFLOAT = 'G';
+constexpr char OP_SHORT_BINUNICODE = '\x8c';
+constexpr char OP_BINUNICODE = 'X';
+constexpr char OP_BINUNICODE8 = '\x8d';
+constexpr char OP_SHORT_BINBYTES = 'C';
+constexpr char OP_BINBYTES = 'B';
+constexpr char OP_BINBYTES8 = '\x8e';
+constexpr char OP_BYTEARRAY8 = '\x96';
+constexpr char OP_EMPTY_LIST = ']';
+constexpr char OP_APPEND = 'a';
+constexpr char OP_APPENDS = 'e';
+constexpr char OP_EMPTY_DICT = '}';
+constexpr char OP_SETITEM = 's';
+constexpr char OP_SETITEMS = 'u';
+constexpr char OP_EMPTY_TUPLE = ')';
+constexpr char OP_TUPLE1 = '\x85';
+constexpr char OP_TUPLE2 = '\x86';
+constexpr char OP_TUPLE3 = '\x87';
+constexpr char OP_TUPLE = 't';
+constexpr char OP_MARK = '(';
+constexpr char OP_POP = '0';
+constexpr char OP_MEMOIZE = '\x94';
+constexpr char OP_BINPUT = 'q';
+constexpr char OP_LONG_BINPUT = 'r';
+constexpr char OP_BINGET = 'h';
+constexpr char OP_LONG_BINGET = 'j';
+constexpr char OP_BINPERSID = 'Q';
+
+void PutU32(std::string* out, uint32_t v) {
+  char buf[4];
+  buf[0] = static_cast<char>(v & 0xff);
+  buf[1] = static_cast<char>((v >> 8) & 0xff);
+  buf[2] = static_cast<char>((v >> 16) & 0xff);
+  buf[3] = static_cast<char>((v >> 24) & 0xff);
+  out->append(buf, 4);
+}
+
+void EncodeValue(const Value& v, std::string* out) {
+  switch (v.kind) {
+    case Value::Kind::None:
+      out->push_back(OP_NONE);
+      return;
+    case Value::Kind::Bool:
+      out->push_back(v.b ? OP_NEWTRUE : OP_NEWFALSE);
+      return;
+    case Value::Kind::Int: {
+      if (v.i >= INT32_MIN && v.i <= INT32_MAX) {
+        out->push_back(OP_BININT);
+        PutU32(out, static_cast<uint32_t>(static_cast<int32_t>(v.i)));
+      } else {
+        // LONG1: n bytes little-endian two's complement.
+        char bytes[9];
+        uint64_t u = static_cast<uint64_t>(v.i);
+        int n = 0;
+        for (; n < 8; ++n) bytes[n] = static_cast<char>((u >> (8 * n)) & 0xff);
+        // Trim redundant sign bytes.
+        while (n > 1) {
+          uint8_t hi = static_cast<uint8_t>(bytes[n - 1]);
+          uint8_t next = static_cast<uint8_t>(bytes[n - 2]);
+          if ((hi == 0x00 && !(next & 0x80)) ||
+              (hi == 0xff && (next & 0x80)))
+            --n;
+          else
+            break;
+        }
+        out->push_back(OP_LONG1);
+        out->push_back(static_cast<char>(n));
+        out->append(bytes, n);
+      }
+      return;
+    }
+    case Value::Kind::Float: {
+      // BINFLOAT: big-endian IEEE 754 double.
+      out->push_back(OP_BINFLOAT);
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.f), "double must be 64-bit");
+      std::memcpy(&bits, &v.f, 8);
+      for (int shift = 56; shift >= 0; shift -= 8)
+        out->push_back(static_cast<char>((bits >> shift) & 0xff));
+      return;
+    }
+    case Value::Kind::Str:
+      out->push_back(OP_BINUNICODE);
+      PutU32(out, static_cast<uint32_t>(v.s.size()));
+      out->append(v.s);
+      return;
+    case Value::Kind::Bytes:
+      out->push_back(OP_BINBYTES);
+      PutU32(out, static_cast<uint32_t>(v.s.size()));
+      out->append(v.s);
+      return;
+    case Value::Kind::List:
+      out->push_back(OP_EMPTY_LIST);
+      if (!v.items.empty()) {
+        out->push_back(OP_MARK);
+        for (const auto& item : v.items) EncodeValue(item, out);
+        out->push_back(OP_APPENDS);
+      }
+      return;
+    case Value::Kind::Tuple:
+      out->push_back(OP_MARK);
+      for (const auto& item : v.items) EncodeValue(item, out);
+      out->push_back(OP_TUPLE);
+      return;
+    case Value::Kind::Dict:
+      out->push_back(OP_EMPTY_DICT);
+      if (!v.dict.empty()) {
+        out->push_back(OP_MARK);
+        for (const auto& kv : v.dict) {
+          EncodeValue(kv.first, out);
+          EncodeValue(kv.second, out);
+        }
+        out->push_back(OP_SETITEMS);
+      }
+      return;
+    case Value::Kind::Ref: {
+      // Persistent id ("ref", oid, owner) + BINPERSID — resolved by the
+      // proxy/client persistent_load hooks (ray_tpu/client/common.py).
+      Value pid = Value::Tuple({Value::Str("ref"), Value::Bytes(v.s),
+                                v.s2.empty() ? Value::None()
+                                             : Value::Str(v.s2)});
+      EncodeValue(pid, out);
+      out->push_back(OP_BINPERSID);
+      return;
+    }
+    case Value::Kind::Actor:
+      throw PickleError("encoding actor handles from C++ is not supported; "
+                        "pass the actor id to ActorCall instead");
+  }
+  throw PickleError("unreachable value kind");
+}
+
+// Stack/memo hold shared_ptr<Value>: CPython memoizes containers while
+// still empty and fills them afterwards (EMPTY_LIST MEMOIZE ... APPENDS),
+// so memo entries must alias the in-progress object, not snapshot it.
+// Container assembly copies completed children (pickling is post-order);
+// direct self-reference is detected and rejected loudly.
+class Decoder {
+ public:
+  using VP = std::shared_ptr<Value>;
+  explicit Decoder(const std::string& data) : data_(data) {}
+
+  Value Run() {
+    while (true) {
+      char op = Next();
+      switch (op) {
+        case OP_PROTO:
+          Next();
+          break;
+        case OP_FRAME:
+          Skip(8);
+          break;
+        case OP_STOP: {
+          if (stack_.empty()) throw PickleError("STOP on empty stack");
+          return Value(*stack_.back());
+        }
+        case OP_NONE:
+          PushV(Value::None());
+          break;
+        case OP_NEWTRUE:
+          PushV(Value::Bool(true));
+          break;
+        case OP_NEWFALSE:
+          PushV(Value::Bool(false));
+          break;
+        case OP_BININT:
+          PushV(Value::Int(static_cast<int32_t>(ReadU32())));
+          break;
+        case OP_BININT1:
+          PushV(Value::Int(static_cast<uint8_t>(Next())));
+          break;
+        case OP_BININT2: {
+          uint16_t v = static_cast<uint8_t>(Next());
+          v |= static_cast<uint16_t>(static_cast<uint8_t>(Next())) << 8;
+          PushV(Value::Int(v));
+          break;
+        }
+        case OP_LONG1: {
+          int n = static_cast<uint8_t>(Next());
+          if (n > 8)
+            throw PickleError("LONG1 wider than int64 unsupported");
+          uint64_t u = 0;
+          bool neg = false;
+          for (int k = 0; k < n; ++k) {
+            uint8_t byte = static_cast<uint8_t>(Next());
+            u |= static_cast<uint64_t>(byte) << (8 * k);
+            if (k == n - 1) neg = byte & 0x80;
+          }
+          if (neg && n < 8) u |= ~0ULL << (8 * n);  // sign-extend
+          PushV(Value::Int(static_cast<int64_t>(u)));
+          break;
+        }
+        case OP_BINFLOAT: {
+          uint64_t bits = 0;
+          for (int k = 0; k < 8; ++k)
+            bits = (bits << 8) | static_cast<uint8_t>(Next());
+          double d;
+          std::memcpy(&d, &bits, 8);
+          PushV(Value::Float(d));
+          break;
+        }
+        case OP_SHORT_BINUNICODE:
+          PushV(Value::Str(ReadStr(static_cast<uint8_t>(Next()))));
+          break;
+        case OP_BINUNICODE:
+          PushV(Value::Str(ReadStr(ReadU32())));
+          break;
+        case OP_BINUNICODE8:
+          PushV(Value::Str(ReadStr(ReadU64())));
+          break;
+        case OP_SHORT_BINBYTES:
+          PushV(Value::Bytes(ReadStr(static_cast<uint8_t>(Next()))));
+          break;
+        case OP_BINBYTES:
+          PushV(Value::Bytes(ReadStr(ReadU32())));
+          break;
+        case OP_BINBYTES8:
+        case OP_BYTEARRAY8:
+          PushV(Value::Bytes(ReadStr(ReadU64())));
+          break;
+        case OP_EMPTY_LIST:
+          PushV(Value::List({}));
+          break;
+        case OP_APPEND: {
+          VP item = Pop();
+          if (item == stack_.back())
+            throw PickleError("self-referential list unsupported");
+          Top().items.push_back(*item);
+          break;
+        }
+        case OP_APPENDS: {
+          size_t mark = PopMark();
+          if (mark == 0) throw PickleError("APPENDS with no list under MARK");
+          VP list = stack_[mark - 1];
+          for (size_t k = mark; k < stack_.size(); ++k) {
+            if (stack_[k] == list)
+              throw PickleError("self-referential list unsupported");
+            list->items.push_back(*stack_[k]);
+          }
+          stack_.resize(mark);
+          break;
+        }
+        case OP_EMPTY_DICT:
+          PushV(Value::Dict({}));
+          break;
+        case OP_SETITEM: {
+          VP val = Pop();
+          VP key = Pop();
+          if (val == stack_.back() || key == stack_.back())
+            throw PickleError("self-referential dict unsupported");
+          Top().dict.emplace_back(*key, *val);
+          break;
+        }
+        case OP_SETITEMS: {
+          size_t mark = PopMark();
+          if (mark == 0) throw PickleError("SETITEMS with no dict under MARK");
+          VP d = stack_[mark - 1];
+          for (size_t k = mark; k + 1 < stack_.size(); k += 2) {
+            if (stack_[k] == d || stack_[k + 1] == d)
+              throw PickleError("self-referential dict unsupported");
+            d->dict.emplace_back(*stack_[k], *stack_[k + 1]);
+          }
+          stack_.resize(mark);
+          break;
+        }
+        case OP_EMPTY_TUPLE:
+          PushV(Value::Tuple({}));
+          break;
+        case OP_TUPLE1: {
+          VP a = Pop();
+          PushV(Value::Tuple({*a}));
+          break;
+        }
+        case OP_TUPLE2: {
+          VP b = Pop();
+          VP a = Pop();
+          PushV(Value::Tuple({*a, *b}));
+          break;
+        }
+        case OP_TUPLE3: {
+          VP c = Pop();
+          VP b = Pop();
+          VP a = Pop();
+          PushV(Value::Tuple({*a, *b, *c}));
+          break;
+        }
+        case OP_TUPLE: {
+          size_t mark = PopMark();
+          Value t = Value::Tuple({});
+          for (size_t k = mark; k < stack_.size(); ++k)
+            t.items.push_back(*stack_[k]);
+          stack_.resize(mark);
+          PushV(std::move(t));
+          break;
+        }
+        case OP_MARK:
+          marks_.push_back(stack_.size());
+          break;
+        case OP_POP:
+          Pop();
+          break;
+        case OP_MEMOIZE:
+          memo_[memo_.size()] = stack_.back();
+          break;
+        case OP_BINPUT:
+          memo_[static_cast<uint8_t>(Next())] = stack_.back();
+          break;
+        case OP_LONG_BINPUT:
+          memo_[ReadU32()] = stack_.back();
+          break;
+        case OP_BINGET:
+          stack_.push_back(MemoGet(static_cast<uint8_t>(Next())));  // alias
+          break;
+        case OP_LONG_BINGET:
+          stack_.push_back(MemoGet(ReadU32()));  // alias
+          break;
+        case OP_BINPERSID: {
+          // ("ref", oid, owner) / ("actor", aid, class, methods, is_async)
+          VP pid = Pop();
+          const auto& t = pid->AsSeq();
+          if (t.empty() || t[0].kind != Value::Kind::Str)
+            throw PickleError("malformed persistent id");
+          if (t[0].s == "ref") {
+            std::string owner =
+                (t.size() > 2 && t[2].kind == Value::Kind::Str) ? t[2].s : "";
+            PushV(Value::Ref(t[1].AsBytes(), owner));
+          } else if (t[0].s == "actor") {
+            Value a;
+            a.kind = Value::Kind::Actor;
+            a.s = t[1].AsBytes();
+            a.s2 = t.size() > 2 && t[2].kind == Value::Kind::Str ? t[2].s : "";
+            PushV(std::move(a));
+          } else {
+            throw PickleError("unknown persistent id tag: " + t[0].s);
+          }
+          break;
+        }
+        default: {
+          char buf[64];
+          std::snprintf(buf, sizeof(buf),
+                        "unsupported pickle opcode 0x%02x at offset %zu",
+                        static_cast<uint8_t>(op), pos_ - 1);
+          throw PickleError(std::string(buf) +
+                            " (value too rich for the C++ subset)");
+        }
+      }
+    }
+  }
+
+ private:
+  char Next() {
+    if (pos_ >= data_.size()) throw PickleError("truncated pickle");
+    return data_[pos_++];
+  }
+  void Skip(size_t n) {
+    if (pos_ + n > data_.size()) throw PickleError("truncated pickle");
+    pos_ += n;
+  }
+  uint32_t ReadU32() {
+    uint32_t v = 0;
+    for (int k = 0; k < 4; ++k)
+      v |= static_cast<uint32_t>(static_cast<uint8_t>(Next())) << (8 * k);
+    return v;
+  }
+  uint64_t ReadU64() {
+    uint64_t v = 0;
+    for (int k = 0; k < 8; ++k)
+      v |= static_cast<uint64_t>(static_cast<uint8_t>(Next())) << (8 * k);
+    return v;
+  }
+  std::string ReadStr(uint64_t n) {
+    if (pos_ + n > data_.size()) throw PickleError("truncated pickle");
+    std::string s = data_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  void PushV(Value v) {
+    stack_.push_back(std::make_shared<Value>(std::move(v)));
+  }
+  VP Pop() {
+    if (stack_.empty()) throw PickleError("pop from empty stack");
+    VP v = std::move(stack_.back());
+    stack_.pop_back();
+    return v;
+  }
+  Value& Top() {
+    if (stack_.empty()) throw PickleError("top of empty stack");
+    return *stack_.back();
+  }
+  size_t PopMark() {
+    if (marks_.empty()) throw PickleError("no MARK on stack");
+    size_t m = marks_.back();
+    marks_.pop_back();
+    if (m > stack_.size()) throw PickleError("corrupt MARK position");
+    return m;
+  }
+  const VP& MemoGet(uint64_t idx) {
+    auto it = memo_.find(idx);
+    if (it == memo_.end()) throw PickleError("memo miss");
+    return it->second;
+  }
+
+  const std::string& data_;
+  size_t pos_ = 0;
+  std::vector<VP> stack_;
+  std::vector<size_t> marks_;
+  std::map<uint64_t, VP> memo_;
+};
+
+}  // namespace
+
+std::string PickleDumps(const Value& v) {
+  std::string out;
+  out.push_back(OP_PROTO);
+  out.push_back('\x03');
+  EncodeValue(v, &out);
+  out.push_back(OP_STOP);
+  return out;
+}
+
+Value PickleLoads(const std::string& data) { return Decoder(data).Run(); }
+
+}  // namespace raytpu

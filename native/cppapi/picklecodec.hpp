@@ -1,0 +1,106 @@
+// Minimal pickle codec for the ray_tpu C++ worker API.
+//
+// Role parity: the reference's C++ worker serializes cross-language values
+// via msgpack inside the Ray object format (src/ray/core_worker/common.h,
+// cpp/src/ray/runtime/task/task_executor.cc). ray_tpu's control plane speaks
+// pickle frames (ray_tpu/cluster/protocol.py), so the C++ client implements
+// the subset of pickle needed for simple-typed values: None/bool/int/float/
+// str/bytes/list/tuple/dict plus persistent-id markers for ObjectRefs and
+// ActorHandles (ray_tpu/client/common.py marker forms).
+//
+// Encoder emits protocol 3 (BINBYTES needs >=3); decoder accepts CPython
+// protocol <=5 output over the same value subset and fails loudly (with the
+// offending opcode) on anything richer — richer results should be fetched by
+// a Python driver, or returned as bytes.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace raytpu {
+
+class PickleError : public std::runtime_error {
+ public:
+  explicit PickleError(const std::string& what) : std::runtime_error(what) {}
+};
+
+struct Value {
+  enum class Kind {
+    None, Bool, Int, Float, Str, Bytes, List, Tuple, Dict, Ref, Actor
+  };
+  Kind kind = Kind::None;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;   // Str/Bytes payload; Ref object id; Actor actor id
+  std::string s2;  // Ref owner address ("" = None); Actor class name
+  std::vector<Value> items;                      // List/Tuple
+  std::vector<std::pair<Value, Value>> dict;     // Dict (insertion order)
+
+  static Value None() { return Value{}; }
+  static Value Bool(bool v) { Value x; x.kind = Kind::Bool; x.b = v; return x; }
+  static Value Int(int64_t v) { Value x; x.kind = Kind::Int; x.i = v; return x; }
+  static Value Float(double v) { Value x; x.kind = Kind::Float; x.f = v; return x; }
+  static Value Str(std::string v) {
+    Value x; x.kind = Kind::Str; x.s = std::move(v); return x;
+  }
+  static Value Bytes(std::string v) {
+    Value x; x.kind = Kind::Bytes; x.s = std::move(v); return x;
+  }
+  static Value List(std::vector<Value> v) {
+    Value x; x.kind = Kind::List; x.items = std::move(v); return x;
+  }
+  static Value Tuple(std::vector<Value> v) {
+    Value x; x.kind = Kind::Tuple; x.items = std::move(v); return x;
+  }
+  static Value Dict(std::vector<std::pair<Value, Value>> v) {
+    Value x; x.kind = Kind::Dict; x.dict = std::move(v); return x;
+  }
+  static Value Ref(std::string oid, std::string owner) {
+    Value x; x.kind = Kind::Ref; x.s = std::move(oid);
+    x.s2 = std::move(owner); return x;
+  }
+
+  bool IsNone() const { return kind == Kind::None; }
+  bool AsBool() const { Expect(Kind::Bool, "bool"); return b; }
+  int64_t AsInt() const { Expect(Kind::Int, "int"); return i; }
+  double AsFloat() const {
+    if (kind == Kind::Int) return static_cast<double>(i);
+    Expect(Kind::Float, "float");
+    return f;
+  }
+  const std::string& AsStr() const { Expect(Kind::Str, "str"); return s; }
+  const std::string& AsBytes() const { Expect(Kind::Bytes, "bytes"); return s; }
+  const std::vector<Value>& AsSeq() const {
+    if (kind != Kind::List && kind != Kind::Tuple)
+      throw PickleError("expected list/tuple, got kind " +
+                        std::to_string(static_cast<int>(kind)));
+    return items;
+  }
+  const Value* Find(const std::string& key) const {
+    Expect(Kind::Dict, "dict");
+    for (const auto& kv : dict)
+      if (kv.first.kind == Kind::Str && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+
+ private:
+  void Expect(Kind k, const char* name) const {
+    if (kind != k)
+      throw PickleError(std::string("expected ") + name + ", got kind " +
+                        std::to_string(static_cast<int>(kind)));
+  }
+};
+
+// Serialize a Value as a pickle (protocol 3).
+std::string PickleDumps(const Value& v);
+
+// Parse a CPython pickle (protocol <=5) of simple-typed values.
+Value PickleLoads(const std::string& data);
+
+}  // namespace raytpu

@@ -1,0 +1,255 @@
+#include "raytpu.hpp"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+namespace raytpu {
+namespace {
+
+Value MakeKwargs(std::vector<std::pair<Value, Value>> kv) {
+  Value d;
+  d.kind = Value::Kind::Dict;
+  d.dict = std::move(kv);
+  return d;
+}
+
+Value EncArgs(const std::vector<Value>& args) {
+  // Proxy expects args_blob = pickle((args_list, kwargs_dict)).
+  Value tup = Value::Tuple({Value::List(args), Value::Dict({})});
+  return Value::Bytes(PickleDumps(tup));
+}
+
+Value OptsDict(const std::vector<std::pair<std::string, Value>>& opts) {
+  std::vector<std::pair<Value, Value>> kv;
+  kv.reserve(opts.size());
+  for (const auto& o : opts) kv.emplace_back(Value::Str(o.first), o.second);
+  return MakeKwargs(std::move(kv));
+}
+
+ObjectRef RefFromValue(const Value& v) {
+  if (v.kind != Value::Kind::Ref)
+    throw RpcError("expected an object ref in proxy response");
+  return ObjectRef{v.s, v.s2};
+}
+
+}  // namespace
+
+Client::Client(const std::string& host, int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) throw RpcError("socket() failed");
+  int one = 1;
+  ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd_);
+    throw RpcError("bad host address: " + host);
+  }
+  if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd_);
+    throw RpcError("connect to " + host + ":" + std::to_string(port) +
+                   " failed: " + std::strerror(errno));
+  }
+  Value resp = Call("cp_connect", {{Value::Str("meta"), Value::Dict({})}});
+  const Value* sess = resp.Find("session");
+  if (sess == nullptr) throw RpcError("proxy connect: no session in reply");
+  session_ = sess->AsStr();
+}
+
+Client::~Client() {
+  if (fd_ >= 0) {
+    try {
+      if (!session_.empty())
+        Call("cp_disconnect", {{Value::Str("session"), Value::Str(session_)}});
+    } catch (...) {
+    }
+    ::close(fd_);
+  }
+}
+
+void Client::SendFrame(const std::string& payload) {
+  char hdr[4];
+  uint32_t n = static_cast<uint32_t>(payload.size());
+  hdr[0] = static_cast<char>(n & 0xff);
+  hdr[1] = static_cast<char>((n >> 8) & 0xff);
+  hdr[2] = static_cast<char>((n >> 16) & 0xff);
+  hdr[3] = static_cast<char>((n >> 24) & 0xff);
+  std::string buf(hdr, 4);
+  buf += payload;
+  size_t sent = 0;
+  while (sent < buf.size()) {
+    ssize_t k = ::send(fd_, buf.data() + sent, buf.size() - sent, 0);
+    if (k <= 0) throw RpcError("send failed (proxy gone?)");
+    sent += static_cast<size_t>(k);
+  }
+}
+
+std::string Client::RecvFrame() {
+  auto recv_exact = [this](size_t n) {
+    std::string out(n, '\0');
+    size_t got = 0;
+    while (got < n) {
+      ssize_t k = ::recv(fd_, &out[got], n - got, 0);
+      if (k <= 0) throw RpcError("recv failed (proxy gone?)");
+      got += static_cast<size_t>(k);
+    }
+    return out;
+  };
+  std::string hdr = recv_exact(4);
+  uint32_t n = static_cast<uint8_t>(hdr[0]) |
+               (static_cast<uint32_t>(static_cast<uint8_t>(hdr[1])) << 8) |
+               (static_cast<uint32_t>(static_cast<uint8_t>(hdr[2])) << 16) |
+               (static_cast<uint32_t>(static_cast<uint8_t>(hdr[3])) << 24);
+  return recv_exact(n);
+}
+
+Value Client::Call(const std::string& method,
+                   std::vector<std::pair<Value, Value>> kwargs) {
+  if (method != "cp_connect" && !session_.empty()) {
+    kwargs.emplace_back(Value::Str("session"), Value::Str(session_));
+  }
+  Value req = Value::Tuple({Value::Str(method), MakeKwargs(std::move(kwargs))});
+  SendFrame(PickleDumps(req));
+  Value resp = PickleLoads(RecvFrame());
+  // RPC layer wraps as (ok, payload).
+  const auto& pair = resp.AsSeq();
+  if (pair.size() != 2) throw RpcError("malformed RPC response");
+  if (!(pair[0].kind == Value::Kind::Bool && pair[0].b))
+    throw RpcError("RPC-level error from proxy");
+  const Value& payload = pair[1];
+  const Value* ok = payload.Find("ok");
+  if (ok == nullptr || ok->kind != Value::Kind::Bool || !ok->b) {
+    const Value* err = payload.Find("error");
+    throw RpcError(err != nullptr && err->kind == Value::Kind::Str
+                       ? err->s
+                       : "proxy call failed");
+  }
+  return payload;
+}
+
+ObjectRef Client::Put(const Value& value) {
+  Value resp = Call("cp_put",
+                    {{Value::Str("blob"), Value::Bytes(PickleDumps(value))}});
+  return RefFromValue(PickleLoads(resp.Find("ref")->AsBytes()));
+}
+
+Value Client::Get(const ObjectRef& ref, double timeout_s) {
+  auto vals = Get(std::vector<ObjectRef>{ref}, timeout_s);
+  return std::move(vals[0]);
+}
+
+std::vector<Value> Client::Get(const std::vector<ObjectRef>& refs,
+                               double timeout_s) {
+  std::vector<Value> oids;
+  oids.reserve(refs.size());
+  for (const auto& r : refs) oids.push_back(Value::Bytes(r.id));
+  Value resp = Call(
+      "cp_get",
+      {{Value::Str("oids"), Value::List(std::move(oids))},
+       {Value::Str("timeout"),
+        timeout_s < 0 ? Value::None() : Value::Float(timeout_s)}});
+  const Value* vals = resp.Find("values");
+  std::vector<Value> out;
+  for (const auto& blob : vals->AsSeq())
+    out.push_back(PickleLoads(blob.AsBytes()));
+  return out;
+}
+
+std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Client::Wait(
+    const std::vector<ObjectRef>& refs, int num_returns, double timeout_s) {
+  std::vector<Value> oids;
+  for (const auto& r : refs) oids.push_back(Value::Bytes(r.id));
+  Value resp = Call(
+      "cp_wait",
+      {{Value::Str("oids"), Value::List(std::move(oids))},
+       {Value::Str("num_returns"), Value::Int(num_returns)},
+       {Value::Str("timeout"),
+        timeout_s < 0 ? Value::None() : Value::Float(timeout_s)}});
+  auto to_refs = [&refs](const Value& ids) {
+    std::vector<ObjectRef> out;
+    for (const auto& oid : ids.AsSeq()) {
+      for (const auto& r : refs)
+        if (r.id == oid.AsBytes()) {
+          out.push_back(r);
+          break;
+        }
+    }
+    return out;
+  };
+  return {to_refs(*resp.Find("ready")), to_refs(*resp.Find("not_ready"))};
+}
+
+void Client::Release(const std::vector<ObjectRef>& refs) {
+  std::vector<Value> oids;
+  for (const auto& r : refs) oids.push_back(Value::Bytes(r.id));
+  Call("cp_release", {{Value::Str("oids"), Value::List(std::move(oids))}});
+}
+
+ObjectRef Client::Task(
+    const std::string& import_path, const std::vector<Value>& args,
+    const std::vector<std::pair<std::string, Value>>& opts) {
+  Value resp = Call("cp_task",
+                    {{Value::Str("desc"), Value::None()},
+                     {Value::Str("blob"), Value::None()},
+                     {Value::Str("args_blob"), EncArgs(args)},
+                     {Value::Str("opts"), OptsDict(opts)},
+                     {Value::Str("import_path"), Value::Str(import_path)}});
+  Value refs = PickleLoads(resp.Find("refs")->AsBytes());
+  return RefFromValue(refs.AsSeq().at(0));
+}
+
+ActorHandle Client::CreateActor(
+    const std::string& import_path, const std::vector<Value>& args,
+    const std::vector<std::pair<std::string, Value>>& opts) {
+  Value resp =
+      Call("cp_actor_create",
+           {{Value::Str("desc"), Value::None()},
+            {Value::Str("blob"), Value::None()},
+            {Value::Str("args_blob"), EncArgs(args)},
+            {Value::Str("opts"), OptsDict(opts)},
+            {Value::Str("import_path"), Value::Str(import_path)}});
+  Value actor = PickleLoads(resp.Find("actor")->AsBytes());
+  if (actor.kind != Value::Kind::Actor)
+    throw RpcError("expected an actor handle in proxy response");
+  return ActorHandle{actor.s, actor.s2};
+}
+
+ObjectRef Client::ActorCall(const ActorHandle& actor,
+                            const std::string& method,
+                            const std::vector<Value>& args) {
+  Value resp = Call("cp_actor_task",
+                    {{Value::Str("actor_id"), Value::Bytes(actor.id)},
+                     {Value::Str("method_name"), Value::Str(method)},
+                     {Value::Str("args_blob"), EncArgs(args)},
+                     {Value::Str("opts"), Value::Dict({})}});
+  Value refs = PickleLoads(resp.Find("refs")->AsBytes());
+  return RefFromValue(refs.AsSeq().at(0));
+}
+
+void Client::KillActor(const ActorHandle& actor, bool no_restart) {
+  Call("cp_actor_kill", {{Value::Str("actor_id"), Value::Bytes(actor.id)},
+                         {Value::Str("no_restart"), Value::Bool(no_restart)}});
+}
+
+ActorHandle Client::GetActor(const std::string& name, const std::string& ns) {
+  Value resp = Call("cp_get_actor", {{Value::Str("name"), Value::Str(name)},
+                                     {Value::Str("namespace"), Value::Str(ns)}});
+  Value actor = PickleLoads(resp.Find("actor")->AsBytes());
+  if (actor.kind != Value::Kind::Actor)
+    throw RpcError("expected an actor handle in proxy response");
+  return ActorHandle{actor.s, actor.s2};
+}
+
+Value Client::ClusterInfo(const std::string& kind) {
+  Value resp = Call("cp_cluster_info", {{Value::Str("kind"), Value::Str(kind)}});
+  return *resp.Find("value");
+}
+
+}  // namespace raytpu

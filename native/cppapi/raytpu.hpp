@@ -1,0 +1,97 @@
+// ray_tpu C++ worker API.
+//
+// Role parity: cpp/include/ray/api.h in the reference (the C++ worker's
+// public API: Put/Get/Task/Actor over the core worker). ray_tpu's C++
+// client is a thin driver over the in-cluster client proxy
+// (ray_tpu/client/server.py) — the same proxy protocol the Python thin
+// client uses — speaking length-prefixed pickle frames
+// (ray_tpu/cluster/protocol.py wire format).
+//
+// Tasks and actors are addressed by Python import path ("module:callable"),
+// the cross-language calling convention (reference analog:
+// cpp/src/ray/runtime/task/task_submitter.cc cross-language descriptors).
+// Values are the simple-typed pickle subset in picklecodec.hpp.
+//
+// Example:
+//   raytpu::Client c("127.0.0.1", 10001);
+//   auto ref = c.Task("my_mod:add", {raytpu::Value::Int(2),
+//                                    raytpu::Value::Int(3)});
+//   int64_t sum = c.Get(ref).AsInt();
+#pragma once
+
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "picklecodec.hpp"
+
+namespace raytpu {
+
+class RpcError : public std::runtime_error {
+ public:
+  explicit RpcError(const std::string& what) : std::runtime_error(what) {}
+};
+
+struct ObjectRef {
+  std::string id;     // binary object id
+  std::string owner;  // owner address ("" = unknown)
+};
+
+struct ActorHandle {
+  std::string id;          // binary actor id
+  std::string class_name;  // informational
+};
+
+class Client {
+ public:
+  // Connect to a client proxy (ray_tpu client-server) at host:port.
+  Client(const std::string& host, int port);
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  const std::string& session() const { return session_; }
+
+  // -- objects -----------------------------------------------------------
+  ObjectRef Put(const Value& value);
+  Value Get(const ObjectRef& ref, double timeout_s = -1.0);
+  std::vector<Value> Get(const std::vector<ObjectRef>& refs,
+                         double timeout_s = -1.0);
+  // (ready, not_ready) after up to timeout_s (<0 = block until num_returns).
+  std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Wait(
+      const std::vector<ObjectRef>& refs, int num_returns,
+      double timeout_s = -1.0);
+  // Drop the proxy-side pins for these refs (C++ has no GC hook; call when
+  // done, or rely on session teardown at destruction).
+  void Release(const std::vector<ObjectRef>& refs);
+
+  // -- tasks / actors ------------------------------------------------------
+  // Submit `import_path(*args)` as a cluster task; returns its result ref.
+  // args may include Value::Ref(...) markers for object refs.
+  ObjectRef Task(const std::string& import_path,
+                 const std::vector<Value>& args,
+                 const std::vector<std::pair<std::string, Value>>& opts = {});
+  ActorHandle CreateActor(
+      const std::string& import_path, const std::vector<Value>& args,
+      const std::vector<std::pair<std::string, Value>>& opts = {});
+  ObjectRef ActorCall(const ActorHandle& actor, const std::string& method,
+                      const std::vector<Value>& args);
+  void KillActor(const ActorHandle& actor, bool no_restart = true);
+  ActorHandle GetActor(const std::string& name,
+                       const std::string& ns = "");
+
+  // -- introspection -------------------------------------------------------
+  // kind: "nodes" | "cluster_resources" | "available_resources"
+  Value ClusterInfo(const std::string& kind);
+
+ private:
+  Value Call(const std::string& method,
+             std::vector<std::pair<Value, Value>> kwargs);
+  void SendFrame(const std::string& payload);
+  std::string RecvFrame();
+
+  int fd_ = -1;
+  std::string session_;
+};
+
+}  // namespace raytpu

@@ -1,0 +1,11 @@
+"""Client proxy: thin drivers over an in-cluster proxy.
+
+Role parity: python/ray/util/client — ``ray.init("ray://...")``. Here:
+``ray_tpu.init(address="client://host:port")`` (thin Python client), the
+C++ worker API (native/cppapi) speaks the same proxy protocol.
+"""
+
+from ray_tpu.client.runtime import ClientRuntime
+from ray_tpu.client.server import ClientProxy, serve_proxy
+
+__all__ = ["ClientProxy", "ClientRuntime", "serve_proxy"]

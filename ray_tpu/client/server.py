@@ -1,0 +1,316 @@
+"""Client proxy server — remote drivers without a full runtime.
+
+Role parity: python/ray/util/client/server/server.py (RayletServicer) — the
+reference's "Ray client" runs a gRPC proxy inside the cluster; thin clients
+(Python elsewhere, or other languages) drive the cluster through it. Here the
+proxy wraps a full ClusterRuntime driver connection and exposes a small
+simple-typed RPC surface over the standard frame protocol, so both the thin
+Python client (ray_tpu/client/runtime.py, ``init("client://host:port")``)
+and the C++ worker API (native/cppapi) can use it.
+
+Sessions pin every ObjectRef/ActorHandle that crosses the boundary in a
+per-session table (the cluster-side anchor for the distributed refcount,
+reference role: util/client/server/server.py object ownership); clients
+release ids explicitly (batched) and everything drops on disconnect.
+
+Every RPC returns a plain dict ``{"ok": bool, ...}`` and never raises, so
+non-Python clients only ever parse simple pickles; Python clients get the
+original exception back via ``exc`` (pickled) for faithful re-raise.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.client import common
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.options import (ActorOptions, TaskOptions,
+                                  make_actor_options, make_task_options)
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu.core.task_spec import FunctionDescriptor
+from ray_tpu.cluster.protocol import RpcServer
+
+
+def _import_path(path: str):
+    """Resolve "pkg.module:attr" (cross-language task/actor target)."""
+    import importlib
+    mod_name, _, attr = path.partition(":")
+    if not attr:
+        raise ValueError(f"import path {path!r} must be 'module:attr'")
+    obj = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+class _Session:
+    def __init__(self, session_id: str, meta: dict):
+        self.id = session_id
+        self.meta = meta
+        self.refs: Dict[bytes, ObjectRef] = {}
+        self.actors: Dict[bytes, ActorHandle] = {}
+        self.lock = threading.Lock()
+
+
+class ClientProxy:
+    """Serves ``rpc_cp_*`` methods; one instance per hosting driver."""
+
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+        self._rt = runtime
+        self._sessions: Dict[str, _Session] = {}
+        self._lock = threading.Lock()
+        self._server = RpcServer(self, host=host, port=port)
+        self.address = self._server.address
+
+    def stop(self) -> None:
+        with self._lock:
+            sessions, self._sessions = dict(self._sessions), {}
+        for s in sessions.values():
+            with s.lock:
+                s.refs.clear()
+                s.actors.clear()
+        self._server.stop()
+
+    # -- session codec -----------------------------------------------------
+    def _session(self, session: str) -> _Session:
+        s = self._sessions.get(session)
+        if s is None:
+            raise KeyError(f"unknown client session {session!r}")
+        return s
+
+    def _enc(self, s: _Session, obj: Any) -> bytes:
+        def pid(o):
+            m = common.marker_for(o)
+            if m is not None and m[0] == "ref":
+                with s.lock:
+                    s.refs.setdefault(m[1], o)   # pin for the client
+            elif m is not None and m[0] == "actor":
+                with s.lock:
+                    s.actors.setdefault(m[1], o)
+            return m
+        return common.dumps(obj, pid)
+
+    def _dec(self, s: _Session, blob: bytes) -> Any:
+        def pload(pid):
+            kind = pid[0]
+            if kind == "ref":
+                with s.lock:
+                    ref = s.refs.get(pid[1])
+                    if ref is None:
+                        # Ref minted by another session/driver: materialize
+                        # (registers with this driver's tracker) and pin.
+                        ref = ObjectRef(ObjectID(pid[1]), owner=pid[2])
+                        s.refs[pid[1]] = ref
+                return ref
+            if kind == "actor":
+                with s.lock:
+                    h = s.actors.get(pid[1])
+                    if h is None:
+                        h = ActorHandle(ActorID(pid[1]), pid[2], pid[3],
+                                        pid[4])
+                        s.actors[pid[1]] = h
+                return h
+            raise pickle.UnpicklingError(f"unknown persistent id {pid!r}")
+        return common.loads(blob, pload)
+
+    @staticmethod
+    def _fail(e: BaseException) -> dict:
+        try:
+            exc = pickle.dumps(e, protocol=5)
+        except Exception:
+            exc = None
+        return {"ok": False, "error": f"{type(e).__name__}: {e}",
+                "traceback": traceback.format_exc(), "exc": exc}
+
+    # -- lifecycle ---------------------------------------------------------
+    def rpc_cp_connect(self, meta: Optional[dict] = None) -> dict:
+        session_id = os.urandom(8).hex()
+        with self._lock:
+            self._sessions[session_id] = _Session(session_id, meta or {})
+        return {"ok": True, "session": session_id,
+                "address": getattr(self._rt, "address", None),
+                "namespace": getattr(self._rt, "namespace", "")}
+
+    def rpc_cp_disconnect(self, session: str) -> dict:
+        with self._lock:
+            s = self._sessions.pop(session, None)
+        if s is not None:
+            with s.lock:
+                s.refs.clear()
+                s.actors.clear()
+        return {"ok": True}
+
+    def rpc_cp_release(self, session: str, oids: List[bytes]) -> dict:
+        try:
+            s = self._session(session)
+            with s.lock:
+                for oid in oids:
+                    s.refs.pop(oid, None)
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    # -- objects -----------------------------------------------------------
+    def rpc_cp_put(self, session: str, blob: bytes) -> dict:
+        try:
+            s = self._session(session)
+            ref = self._rt.put(self._dec(s, blob))
+            return {"ok": True, "ref": self._enc(s, ref)}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    def rpc_cp_get(self, session: str, oids: List[bytes],
+                   timeout: Optional[float] = None) -> dict:
+        try:
+            s = self._session(session)
+            with s.lock:
+                refs = [s.refs.get(oid) or ObjectRef(ObjectID(oid))
+                        for oid in oids]
+            vals = self._rt.get(refs, timeout=timeout)
+            return {"ok": True, "values": [self._enc(s, v) for v in vals]}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    def rpc_cp_wait(self, session: str, oids: List[bytes], num_returns: int,
+                    timeout: Optional[float] = None) -> dict:
+        try:
+            s = self._session(session)
+            with s.lock:
+                refs = [s.refs.get(oid) or ObjectRef(ObjectID(oid))
+                        for oid in oids]
+            ready, rest = self._rt.wait(refs, num_returns=num_returns,
+                                        timeout=timeout)
+            return {"ok": True,
+                    "ready": [r.id.binary() for r in ready],
+                    "not_ready": [r.id.binary() for r in rest]}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    # -- tasks -------------------------------------------------------------
+    def rpc_cp_task(self, session: str, desc: Optional[FunctionDescriptor],
+                    blob: Optional[bytes], args_blob: bytes,
+                    opts: Optional[dict] = None,
+                    import_path: Optional[str] = None) -> dict:
+        try:
+            s = self._session(session)
+            if import_path is not None:
+                fn = _import_path(import_path)
+                desc, blob = FunctionDescriptor.for_callable(fn)
+            topts = (opts if isinstance(opts, TaskOptions)
+                     else make_task_options(None, **(opts or {})))
+            args, kwargs = self._dec(s, args_blob)
+            refs = self._rt.submit_task(desc, blob, args, kwargs, topts)
+            return {"ok": True, "refs": self._enc(s, refs)}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    # -- actors ------------------------------------------------------------
+    def rpc_cp_actor_create(self, session: str,
+                            desc: Optional[FunctionDescriptor],
+                            blob: Optional[bytes], args_blob: bytes,
+                            opts: Optional[dict] = None,
+                            methods: Optional[dict] = None,
+                            is_async: bool = False,
+                            import_path: Optional[str] = None) -> dict:
+        try:
+            s = self._session(session)
+            if import_path is not None:
+                cls = _import_path(import_path)
+                desc, blob = FunctionDescriptor.for_callable(cls)
+                methods = ActorClass._scan_methods(cls)
+                import inspect
+                is_async = any(
+                    inspect.iscoroutinefunction(getattr(cls, m))
+                    for m in methods)
+            aopts = (opts if isinstance(opts, ActorOptions)
+                     else make_actor_options(None, **(opts or {})))
+            args, kwargs = self._dec(s, args_blob)
+            handle = self._rt.create_actor(desc, blob, args, kwargs, aopts,
+                                           methods or {}, is_async)
+            return {"ok": True, "actor": self._enc(s, handle)}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    def rpc_cp_actor_task(self, session: str, actor_id: bytes,
+                          method_name: str, args_blob: bytes,
+                          opts: Optional[dict] = None) -> dict:
+        try:
+            s = self._session(session)
+            with s.lock:
+                handle = s.actors.get(actor_id)
+            if handle is None:
+                raise ValueError(
+                    f"actor {actor_id.hex()[:8]} not known to this session")
+            topts = (opts if isinstance(opts, TaskOptions)
+                     else make_task_options(None, **(opts or {})))
+            args, kwargs = self._dec(s, args_blob)
+            refs = self._rt.submit_actor_task(handle, method_name, args,
+                                              kwargs, topts)
+            return {"ok": True, "refs": self._enc(s, refs)}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    def rpc_cp_actor_kill(self, session: str, actor_id: bytes,
+                          no_restart: bool = True) -> dict:
+        try:
+            s = self._session(session)
+            with s.lock:
+                handle = s.actors.get(actor_id)
+            if handle is None:
+                handle = ActorHandle(ActorID(actor_id), "", {}, False)
+            self._rt.kill_actor(handle, no_restart=no_restart)
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    def rpc_cp_get_actor(self, session: str, name: str,
+                         namespace: str = "") -> dict:
+        try:
+            s = self._session(session)
+            handle = self._rt.get_actor(name, namespace)
+            return {"ok": True, "actor": self._enc(s, handle)}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    def rpc_cp_cancel(self, session: str, oid: bytes,
+                      force: bool = False) -> dict:
+        try:
+            s = self._session(session)
+            with s.lock:
+                ref = s.refs.get(oid) or ObjectRef(ObjectID(oid))
+            self._rt.cancel(ref, force=force)
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+    # -- cluster introspection --------------------------------------------
+    def rpc_cp_cluster_info(self, session: str, kind: str) -> dict:
+        try:
+            if kind == "nodes":
+                return {"ok": True, "value": self._rt.nodes()}
+            if kind == "cluster_resources":
+                return {"ok": True, "value": self._rt.cluster_resources()}
+            if kind == "available_resources":
+                return {"ok": True, "value": self._rt.available_resources()}
+            if kind == "timeline":
+                return {"ok": True, "value": self._rt.timeline_events()}
+            raise ValueError(f"unknown cluster_info kind {kind!r}")
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+
+
+def serve_proxy(address: Optional[str] = None, host: str = "127.0.0.1",
+                port: int = 0) -> ClientProxy:
+    """Start a proxy, connecting a driver runtime to ``address`` if this
+    process hasn't already got one (CLI: ``ray_tpu client-server``)."""
+    from ray_tpu.core import api
+    if api.is_initialized():
+        rt = api._global_runtime()
+    else:
+        rt = api.init(address=address)
+    return ClientProxy(rt, host=host, port=port)

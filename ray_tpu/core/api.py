@@ -52,6 +52,10 @@ def init(address: Optional[str] = None, *,
             from ray_tpu.core.runtime_local import LocalRuntime
             _runtime = LocalRuntime(num_cpus=num_cpus, num_tpus=num_tpus,
                                     resources=resources)
+        elif address and address.startswith("client://"):
+            # Thin client over an in-cluster proxy (parity: ray://).
+            from ray_tpu.client.runtime import ClientRuntime
+            _runtime = ClientRuntime(address, namespace=namespace)
         else:
             try:
                 from ray_tpu.core.runtime_cluster import ClusterRuntime

@@ -199,6 +199,23 @@ def cmd_job(args) -> None:
         print("stopped" if ok else "not running")
 
 
+def cmd_client_server(args) -> None:
+    """`ray_tpu client-server` — run a client proxy so thin drivers can
+    connect with init("client://host:port") (parity: `ray start
+    --ray-client-server-port`, util/client/server)."""
+    import time
+
+    from ray_tpu.client.server import serve_proxy
+    proxy = serve_proxy(address=_resolve_address(args),
+                        host=args.host, port=args.port)
+    print(f"client proxy listening on client://{proxy.address}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        proxy.stop()
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(
         "ray_tpu", description="TPU-native distributed AI framework CLI")
@@ -220,6 +237,13 @@ def main(argv=None) -> None:
 
     p = sub.add_parser("stop", help="stop local cluster processes")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("client-server",
+                       help="run a client proxy for client:// drivers")
+    p.add_argument("--address", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10001)
+    p.set_defaults(fn=cmd_client_server)
 
     for name, fn in (("status", cmd_status), ("summary", cmd_summary),
                      ("timeline", cmd_timeline), ("metrics", cmd_metrics),

@@ -1,0 +1,25 @@
+"""Importable targets for the C++ worker API smoke test (import-path
+calling convention: "test_cpp_helpers:KVStore" etc.)."""
+
+
+class KVStore:
+    def __init__(self):
+        self.d = {}
+
+    def put(self, k, v):
+        self.d[k] = v
+
+    def bump(self, k):
+        self.d[k] += 1
+        return self.d[k]
+
+
+def explode():
+    raise RuntimeError("boom from python")
+
+
+def shared_structure():
+    """Same list twice: pickles as memoize + BINGET (fill-after-memoize) —
+    regression for the C++ decoder's memo aliasing."""
+    x = [1, 2]
+    return (x, x)

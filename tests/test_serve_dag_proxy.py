@@ -117,9 +117,12 @@ def test_proxy_concurrent_slow_calls(cluster):
         with urllib.request.urlopen(url, timeout=60) as r:
             return json.loads(r.read())
 
+    hit()  # warm-up: replica cold-start must not count against the window
     t0 = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(4) as pool:
         out = list(pool.map(lambda _: hit(), range(4)))
     dt = time.perf_counter() - t0
     assert out == ["ok"] * 4
-    assert dt < 2.4, f"proxy serialized slow calls: {dt:.2f}s"
+    # Serial execution would take >=3.2s; anything clearly under that
+    # proves the proxy overlaps slow calls (margin for loaded CI hosts).
+    assert dt < 3.0, f"proxy serialized slow calls: {dt:.2f}s"
