@@ -111,6 +111,9 @@ class NodeDaemon:
         self._infeasible_recent: Dict[tuple, float] = {}
         self._stopped = False
         self._jobs: Dict[str, dict] = {}   # submission_id -> {proc, log, ...}
+        # In-progress sender-initiated pushes (push_manager.h receive side).
+        self._push_partial: Dict[bytes, dict] = {}
+        self._push_lock = threading.Lock()
         self.server = RpcServer(self, host=host)
         self.address = self.server.address
         reg = get_client(conductor_address).call(
@@ -422,6 +425,19 @@ class NodeDaemon:
         """Detect dead workers: fail their leases / report actor death."""
         while not self._stopped:
             time.sleep(0.2)
+            # Abandoned partial pushes (sender died mid-stream) are dropped
+            # so a fresh push or pull can recreate the entry.
+            with self._push_lock:
+                now = time.monotonic()
+                stale = [o for o, st in self._push_partial.items()
+                         if now - st["ts"] > 30.0]
+                for oid in stale:
+                    self._push_partial.pop(oid, None)
+            for oid in stale:  # store I/O outside the lock
+                try:
+                    self.store.delete(oid)
+                except Exception:
+                    pass
             dead: List[_Worker] = []
             with self._lock:
                 for w in list(self._workers.values()):
@@ -756,6 +772,62 @@ class NodeDaemon:
             return bytes(view[offset:offset + size])
         finally:
             self.store.release(oid)
+
+    def rpc_push_chunk(self, oid: bytes, offset: int, total: int,
+                       chunk: bytes) -> dict:
+        """Receive one chunk of a sender-initiated push (push_manager.h
+        role). Chunks arrive in order on one connection; the first chunk
+        creates the buffer, the last seals + registers the location. A
+        concurrent local pull of the same object wins ties (create raises
+        already-exists → reject the push; pull is the correctness path)."""
+        with self._push_lock:  # guards the dict only — never I/O
+            st = self._push_partial.get(oid)
+            if st is None:
+                if offset != 0:
+                    return {"reject": True}  # stale resumed push
+                if self.store.contains(oid):
+                    return {"done": True}
+                try:
+                    buf = self.store.create(oid, total)
+                except Exception:
+                    return {"done": True}  # being written by pull/another push
+                st = self._push_partial[oid] = {
+                    "buf": buf, "off": 0, "total": total,
+                    "ts": time.monotonic(), "lock": threading.Lock()}
+        with st["lock"]:
+            if offset != st["off"] or st["total"] != total:
+                # Out-of-sequence (competing sender, or a sender that died
+                # and restarted): abort the push and DELETE the unsealed
+                # entry — an orphaned CREATED object would wedge every
+                # future pull (create→already-exists, get→never sealed).
+                with self._push_lock:
+                    self._push_partial.pop(oid, None)
+                try:
+                    self.store.delete(oid)
+                except Exception:
+                    pass
+                return {"reject": True}
+            st["buf"][offset:offset + len(chunk)] = chunk
+            st["off"] += len(chunk)
+            st["ts"] = time.monotonic()
+            if st["off"] < total:
+                return {"ok": True}
+            with self._push_lock:
+                self._push_partial.pop(oid, None)
+        try:
+            self.store.seal(oid)
+        except Exception:
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass
+            return {"reject": True}
+        try:
+            get_client(self.conductor_address).call(
+                "add_object_location", oid=oid, node_id=self.node_id)
+        except Exception:
+            pass  # location registration is best-effort; pulls re-register
+        return {"done": True}
 
     def rpc_delete_object(self, oid: bytes) -> None:
         try:

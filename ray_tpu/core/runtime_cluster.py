@@ -326,6 +326,14 @@ class TaskSubmitter:
 
     def _run_on(self, st: _KeyState, w: _LeasedWorker,
                 recs: List[_TaskRecord]) -> None:
+        # Destination is known now: proactively stream LOCAL arg objects to
+        # the target node (push_manager.h role; best-effort, async) so the
+        # worker's arg resolution finds them in its own store instead of
+        # pulling. Remote args still resolve via the pull path.
+        if w.daemon_address != self.rt.daemon_address:
+            for rec in recs:
+                for dep in rec.task.get("deps") or ():
+                    self.rt.push_mgr.maybe_push(dep, w.daemon_address)
         try:
             get_client(w.address).call(
                 "push_task_batch",
@@ -682,6 +690,8 @@ class ClusterRuntime:
         return self
 
     def _finish_init(self) -> None:
+        from ray_tpu.cluster.push_manager import PushManager
+        self.push_mgr = PushManager(self.store, self.daemon_address)
         self._registered_fns: set = set()
         self._fn_lock = threading.Lock()
         self.submitter = TaskSubmitter(self)
