@@ -37,6 +37,10 @@ class AlgorithmConfig:
         self.lr = 3e-4
         self.train_batch_size = 1024
         self.model_hiddens = (64, 64)
+        # Model catalog knobs (parity: rllib model config / conv_filters).
+        self.model_encoder = "mlp"        # "mlp" | "cnn"
+        self.model_obs_shape = None       # (H, W, C) when encoder == "cnn"
+        self.model_filters = ((16, 3, 2), (32, 3, 2))
         self.seed = 0
         self.learner_remote = False
         self.learner_num_tpus = 0.0
@@ -142,6 +146,23 @@ class Algorithm:
             "num_actions": probe.num_actions,
             "hiddens": tuple(config.model_hiddens),
         }
+        if probe.num_actions < 0:  # continuous: carry the action dims
+            self.module_spec["action_dim"] = getattr(probe, "action_dim", 1)
+        if config.model_encoder != "mlp":
+            if config.model_encoder != "cnn":
+                # "lstm" modules have a sequence-first interface the
+                # collector stack doesn't drive; fail at build, not inside
+                # a remote worker.
+                raise ValueError(
+                    f"model_encoder {config.model_encoder!r} is not "
+                    "trainable via Algorithm (supported: 'mlp', 'cnn'); "
+                    "RecurrentRLModule is a module-level API")
+            self.module_spec["encoder"] = "cnn"
+            if config.model_obs_shape is None:
+                raise ValueError("model_encoder='cnn' requires "
+                                 "model_obs_shape=(H, W, C)")
+            self.module_spec["obs_shape"] = tuple(config.model_obs_shape)
+            self.module_spec["filters"] = tuple(config.model_filters)
         self.setup()
 
     def setup(self) -> None:

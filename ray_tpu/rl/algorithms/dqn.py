@@ -9,8 +9,8 @@ import numpy as np
 
 from ray_tpu.rl import sample_batch as sb
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rl.env import make_env
-from ray_tpu.rl.module import RLModule, mlp_apply, mlp_init
+from ray_tpu.rl.env import episode_stats_of, make_env
+from ray_tpu.rl.module import make_module, mlp_apply, mlp_init
 from ray_tpu.rl.replay_buffer import ReplayBuffer
 from ray_tpu.rl.sample_batch import SampleBatch
 
@@ -39,7 +39,7 @@ class DQNCollector:
                  seed: int = 0):
         import jax
         self.env = make_env(env, num_envs=num_envs, seed=seed)
-        self.module = RLModule(**module_spec)
+        self.module = make_module(module_spec)
         self.obs = self.env.vector_reset(seed=seed)
         self._rng = np.random.default_rng(seed)
         self._q_fn = jax.jit(lambda p, o: self.module.apply(p, o)[0])
@@ -66,11 +66,7 @@ class DQNCollector:
         return SampleBatch({k: np.concatenate(v) for k, v in rows.items()})
 
     def episode_stats(self) -> dict:
-        rets = getattr(self.env, "completed_returns", [])
-        if not rets:
-            return {"episode_reward_mean": float("nan"), "episodes": 0}
-        return {"episode_reward_mean": float(np.mean(rets[-100:])),
-                "episodes": len(rets)}
+        return episode_stats_of(self.env)
 
 
 class DQN(Algorithm):
@@ -81,7 +77,7 @@ class DQN(Algorithm):
         import ray_tpu as rt
 
         cfg: DQNConfig = self.config  # type: ignore[assignment]
-        self.module = RLModule(**self.module_spec)
+        self.module = make_module(self.module_spec)
         self.params = self.module.init(jax.random.PRNGKey(cfg.seed))
         self.target_params = jax.device_get(self.params)
         self.tx = optax.adam(cfg.lr)

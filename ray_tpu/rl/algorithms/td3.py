@@ -1,0 +1,290 @@
+"""TD3 — twin-delayed deterministic policy gradient (continuous control).
+
+Role parity: rllib/algorithms/td3/td3.py (TD3Config/TD3: DDPG + twin Q +
+delayed policy updates + target policy smoothing). TPU-first: the whole
+update — twin critics, (delayed) deterministic actor, polyak targets — is
+ONE jitted step; delay is a traced lax.cond on an update counter, so no
+python branching inside the compiled program. Actions are tanh-squashed to
+the env bounds; exploration adds gaussian noise outside jit (collector
+side, numpy), matching the reference's GaussianNoise exploration.
+
+Learning gate: PendulumVectorEnv (env.py) — reward rises from ~-1300
+(random) toward ~-200; the CI test asserts a clear improvement threshold.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import episode_stats_of, make_env
+from ray_tpu.rl.module import mlp_apply, mlp_init
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 128
+        self.updates_per_iter = 256
+        self.rollout_fragment_length = 64
+        self.gamma = 0.99
+        self.tau = 0.005                # polyak target mix
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.policy_delay = 2           # critic updates per actor update
+        self.target_noise = 0.2         # target policy smoothing sigma
+        self.target_noise_clip = 0.5
+        self.exploration_noise = 0.1    # rollout gaussian sigma (action units)
+        self.algo_class = TD3
+
+
+class TD3Learner:
+    """Jitted TD3 update: twin critics every step, actor+targets every
+    policy_delay-th step (lax.cond keeps it one compiled program)."""
+
+    def __init__(self, module_spec: dict, *, actor_lr: float = 1e-3,
+                 critic_lr: float = 1e-3, gamma: float = 0.99,
+                 tau: float = 0.005, policy_delay: int = 2,
+                 target_noise: float = 0.2, target_noise_clip: float = 0.5,
+                 action_low: float = -1.0, action_high: float = 1.0,
+                 hiddens=(64, 64), seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        obs_dim = module_spec["obs_dim"]
+        act_dim = module_spec.get("action_dim", 1)
+        if module_spec.get("num_actions", -1) > 0:
+            raise ValueError("TD3 is continuous-only; use DQN/SAC for "
+                             "discrete action spaces")
+        scale = (action_high - action_low) / 2.0
+        mid = (action_high + action_low) / 2.0
+
+        key = jax.random.PRNGKey(seed)
+        ka, k1, k2 = jax.random.split(key, 3)
+        params = {
+            "actor": mlp_init(ka, (obs_dim, *hiddens, act_dim)),
+            "q1": mlp_init(k1, (obs_dim + act_dim, *hiddens, 1)),
+            "q2": mlp_init(k2, (obs_dim + act_dim, *hiddens, 1)),
+        }
+        self.params = params
+        self.target = jax.device_get(params)
+        self.tx_actor = optax.adam(actor_lr)
+        self.tx_critic = optax.adam(critic_lr)
+        self.opt_actor = self.tx_actor.init(params["actor"])
+        self.opt_critic = self.tx_critic.init(
+            {"q1": params["q1"], "q2": params["q2"]})
+        self._step_count = jnp.zeros((), jnp.int32)
+        self._key = jax.random.PRNGKey(seed + 17)
+
+        def act(actor_params, obs):
+            raw = mlp_apply(actor_params, obs)
+            return jnp.tanh(raw) * scale + mid
+
+        self.act = act
+
+        def q_val(qp, obs, actions):
+            if actions.ndim == 1:
+                actions = actions[:, None]
+            return mlp_apply(qp, jnp.concatenate([obs, actions], axis=-1)
+                             )[..., 0]
+
+        tx_actor, tx_critic = self.tx_actor, self.tx_critic
+
+        def update_step(params, target, opt_actor, opt_critic, step_count,
+                        key, batch):
+            obs, actions = batch[sb.OBS], batch[sb.ACTIONS]
+            rew, dones = batch[sb.REWARDS], batch[sb.DONES]
+            next_obs = batch[sb.NEXT_OBS]
+            if actions.ndim == 1:
+                actions = actions[:, None]
+
+            # Target policy smoothing: clipped noise on the target action.
+            key, sub = jax.random.split(key)
+            noise = jnp.clip(
+                jax.random.normal(sub, actions.shape) * target_noise * scale,
+                -target_noise_clip * scale, target_noise_clip * scale)
+            a_next = jnp.clip(act(target["actor"], next_obs) + noise,
+                              action_low, action_high)
+            q_next = jnp.minimum(q_val(target["q1"], next_obs, a_next),
+                                 q_val(target["q2"], next_obs, a_next))
+            td_target = jax.lax.stop_gradient(
+                rew + gamma * (1.0 - dones) * q_next)
+
+            def critic_loss(qps):
+                l1 = jnp.mean((q_val(qps["q1"], obs, actions) - td_target)
+                              ** 2)
+                l2 = jnp.mean((q_val(qps["q2"], obs, actions) - td_target)
+                              ** 2)
+                return l1 + l2
+
+            qps = {"q1": params["q1"], "q2": params["q2"]}
+            closs, cgrads = jax.value_and_grad(critic_loss)(qps)
+            cupd, opt_critic = tx_critic.update(cgrads, opt_critic)
+            import optax as _optax
+            qps = _optax.apply_updates(qps, cupd)
+            params = {**params, "q1": qps["q1"], "q2": qps["q2"]}
+
+            def actor_loss(ap):
+                return -jnp.mean(q_val(params["q1"], obs, act(ap, obs)))
+
+            def do_actor(_):
+                aloss, agrads = jax.value_and_grad(actor_loss)(
+                    params["actor"])
+                aupd, new_opt = tx_actor.update(agrads, opt_actor)
+                new_actor = _optax.apply_updates(params["actor"], aupd)
+                new_target = jax.tree_util.tree_map(
+                    lambda t, p: t * (1.0 - tau) + p * tau, target,
+                    {**params, "actor": new_actor})
+                return new_actor, new_opt, new_target, aloss
+
+            def skip_actor(_):
+                return (params["actor"], opt_actor, target,
+                        jnp.zeros((), jnp.float32))
+
+            step_count = step_count + 1
+            actor_p, opt_actor, target, aloss = jax.lax.cond(
+                step_count % policy_delay == 0, do_actor, skip_actor,
+                operand=None)
+            params = {**params, "actor": actor_p}
+            return (params, target, opt_actor, opt_critic, step_count, key,
+                    {"critic_loss": closs, "actor_loss": aloss})
+
+        self._update = jax.jit(update_step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        (self.params, self.target, self.opt_actor, self.opt_critic,
+         self._step_count, self._key, info) = self._update(
+            self.params, self.target, self.opt_actor, self.opt_critic,
+            self._step_count, self._key, dict(batch))
+        return {k: float(v) for k, v in info.items()}
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.params["actor"])
+
+    def state(self) -> dict:
+        import jax
+        return {"params": jax.device_get(self.params),
+                "target": jax.device_get(self.target)}
+
+    def set_state(self, st: dict) -> None:
+        self.params = st["params"]
+        self.target = st["target"]
+
+
+class TD3Collector:
+    """Deterministic policy + gaussian exploration noise (reference's
+    GaussianNoise exploration, rllib/utils/exploration)."""
+
+    def __init__(self, env: Any, module_spec: dict, num_envs: int,
+                 *, hiddens=(64, 64), noise: float = 0.1, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.env = make_env(env, num_envs=num_envs, seed=seed)
+        low, high = self.env.action_low, self.env.action_high
+        scale, mid = (high - low) / 2.0, (high + low) / 2.0
+        self.low, self.high = low, high
+        self.noise = noise * scale
+        self.obs = self.env.vector_reset(seed=seed)
+        self._rng = np.random.default_rng(seed)
+        self._act = jax.jit(
+            lambda p, o: jnp.tanh(mlp_apply(p, o)) * scale + mid)
+
+    def collect(self, actor_params, steps: int,
+                warmup: bool = False) -> SampleBatch:
+        rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS,
+                                sb.DONES)}
+        N = self.env.num_envs
+        act_dim = getattr(self.env, "action_dim", 1)
+        for _ in range(steps):
+            if warmup:
+                a = self._rng.uniform(self.low, self.high, (N, act_dim))
+            else:
+                a = np.asarray(self._act(actor_params, self.obs))
+                a = a.reshape(N, act_dim)
+                a = np.clip(a + self._rng.normal(0, self.noise, a.shape),
+                            self.low, self.high)
+            next_obs, rew, done, _ = self.env.vector_step(a)
+            rows[sb.OBS].append(self.obs.copy())
+            rows[sb.ACTIONS].append(a.astype(np.float32))
+            rows[sb.REWARDS].append(rew)
+            rows[sb.NEXT_OBS].append(next_obs.copy())
+            rows[sb.DONES].append(done)
+            self.obs = next_obs
+        return SampleBatch({k: np.concatenate(v) for k, v in rows.items()})
+
+    def episode_stats(self) -> dict:
+        return episode_stats_of(self.env)
+
+
+class TD3(Algorithm):
+    _default_config = TD3Config
+
+    def setup(self) -> None:
+        import ray_tpu as rt
+
+        cfg: TD3Config = self.config  # type: ignore[assignment]
+        probe = make_env(cfg.env, num_envs=1, seed=cfg.seed)
+        self.learner = TD3Learner(
+            self.module_spec, actor_lr=cfg.actor_lr, critic_lr=cfg.critic_lr,
+            gamma=cfg.gamma, tau=cfg.tau, policy_delay=cfg.policy_delay,
+            target_noise=cfg.target_noise,
+            target_noise_clip=cfg.target_noise_clip,
+            action_low=probe.action_low, action_high=probe.action_high,
+            hiddens=tuple(cfg.model_hiddens), seed=cfg.seed)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        collector_cls = rt.remote(TD3Collector)
+        self.collectors = [
+            collector_cls.options(num_cpus=1).remote(
+                cfg.env, self.module_spec, cfg.num_envs_per_worker,
+                hiddens=tuple(cfg.model_hiddens),
+                noise=cfg.exploration_noise, seed=cfg.seed + i + 1)
+            for i in range(cfg.num_rollout_workers)]
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu as rt
+
+        cfg: TD3Config = self.config  # type: ignore[assignment]
+        warmup = self._timesteps_total < cfg.learning_starts
+        weights = self.learner.get_weights()
+        batches = rt.get([c.collect.remote(weights,
+                                           cfg.rollout_fragment_length,
+                                           warmup=warmup)
+                          for c in self.collectors])
+        for b in batches:
+            self.buffer.add(b)
+            self._timesteps_total += b.count
+        info: Dict[str, float] = {}
+        if self._timesteps_total >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                info = self.learner.update(
+                    self.buffer.sample(cfg.train_batch_size))
+        stats = rt.get([c.episode_stats.remote() for c in self.collectors])
+        rewards = [s["episode_reward_mean"] for s in stats
+                   if not np.isnan(s["episode_reward_mean"])]
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else float("nan"),
+            "timesteps_total": self._timesteps_total,
+            **info,
+        }
+
+    def get_state(self) -> dict:
+        return {"learner": self.learner.state(),
+                "timesteps_total": self._timesteps_total,
+                "iteration": self.iteration}
+
+    def set_state(self, state: dict) -> None:
+        self.learner.set_state(state["learner"])
+        self._timesteps_total = state["timesteps_total"]
+        self.iteration = state["iteration"]

@@ -20,6 +20,19 @@ class VectorEnv:
     num_envs: int
     observation_dim: int
     num_actions: int                # discrete; -1 => continuous
+    action_dim: int = 1             # continuous action dims (Box envs)
+    action_low = -1.0               # bounds: scalar or per-dim array [k]
+    action_high = 1.0
+
+
+def episode_stats_of(env) -> dict:
+    """Shared reward-window stats for collectors (rollout_worker metrics
+    role): mean over the last 100 completed episodes."""
+    rets = getattr(env, "completed_returns", [])
+    if not rets:
+        return {"episode_reward_mean": float("nan"), "episodes": 0}
+    return {"episode_reward_mean": float(np.mean(rets[-100:])),
+            "episodes": len(rets)}
 
     def vector_reset(self, seed: Optional[int] = None) -> np.ndarray:
         raise NotImplementedError
@@ -112,6 +125,10 @@ class GymVectorEnv(VectorEnv):
         self.observation_dim = int(np.prod(space.shape))
         act = self.envs[0].action_space
         self.num_actions = getattr(act, "n", -1)
+        if self.num_actions < 0:  # Box space: keep PER-DIM bounds
+            self.action_dim = int(np.prod(act.shape))
+            self.action_low = np.asarray(act.low, np.float32).reshape(-1)
+            self.action_high = np.asarray(act.high, np.float32).reshape(-1)
         self._seed = seed
         self.episode_returns = np.zeros(num_envs)
         self.completed_returns: list = []
@@ -142,6 +159,74 @@ class GymVectorEnv(VectorEnv):
                 np.array(dones, dtype=np.float32), {})
 
 
+class PendulumVectorEnv(VectorEnv):
+    """Pure-numpy vectorized Pendulum-v1 dynamics (standard constants):
+    the continuous-control learning gate (TD3/continuous-SAC), mirroring
+    CartPoleVectorEnv's role for the discrete algos."""
+
+    G = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    MAX_STEPS = 200
+
+    def __init__(self, num_envs: int = 16, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_dim = 3          # (cos th, sin th, thdot)
+        self.num_actions = -1
+        self.action_dim = 1
+        self.action_low = -self.MAX_TORQUE
+        self.action_high = self.MAX_TORQUE
+        self._rng = np.random.default_rng(seed)
+        self._th = np.zeros(num_envs)
+        self._thdot = np.zeros(num_envs)
+        self._steps = np.zeros(num_envs, dtype=np.int64)
+        self.episode_returns = np.zeros(num_envs)
+        self.completed_returns: list = []
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._th), np.sin(self._th),
+                         self._thdot], axis=-1).astype(np.float32)
+
+    def _reset_indices(self, idx: np.ndarray) -> None:
+        self._th[idx] = self._rng.uniform(-np.pi, np.pi, idx.shape)
+        self._thdot[idx] = self._rng.uniform(-1.0, 1.0, idx.shape)
+        self._steps[idx] = 0
+
+    def vector_reset(self, seed=None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_indices(np.arange(self.num_envs))
+        self.episode_returns[:] = 0
+        return self._obs()
+
+    def vector_step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, dtype=np.float64).reshape(-1),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th, thdot = self._th, self._thdot
+        angle = ((th + np.pi) % (2 * np.pi)) - np.pi
+        costs = angle ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        newthdot = thdot + (3 * self.G / (2 * self.LENGTH) * np.sin(th)
+                            + 3.0 / (self.MASS * self.LENGTH ** 2) * u
+                            ) * self.DT
+        newthdot = np.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._th = th + newthdot * self.DT
+        self._thdot = newthdot
+        self._steps += 1
+        rewards = (-costs).astype(np.float32)
+        self.episode_returns += rewards
+        dones = self._steps >= self.MAX_STEPS
+        if dones.any():
+            idx = np.nonzero(dones)[0]
+            self.completed_returns.extend(self.episode_returns[idx].tolist())
+            self.completed_returns = self.completed_returns[-200:]
+            self.episode_returns[idx] = 0
+            self._reset_indices(idx)
+        return (self._obs(), rewards, dones.astype(np.float32), {})
+
+
 class MultiAgentEnv:
     """Dict-keyed multi-agent protocol (parity: multi_agent_env.py:30).
     reset() -> {agent: obs}; step({agent: action}) ->
@@ -164,6 +249,8 @@ def make_env(env: Any, num_envs: int, seed: int = 0) -> VectorEnv:
         return out
     if env in ("CartPole-v1", "CartPole"):
         return CartPoleVectorEnv(num_envs=num_envs, seed=seed)
+    if env in ("Pendulum-v1", "Pendulum"):
+        return PendulumVectorEnv(num_envs=num_envs, seed=seed)
     if isinstance(env, str):
         return GymVectorEnv(env, num_envs=num_envs, seed=seed)
     raise TypeError(f"cannot build an env from {env!r}")

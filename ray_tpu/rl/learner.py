@@ -16,7 +16,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from ray_tpu.rl import sample_batch as sb
-from ray_tpu.rl.module import RLModule
+from ray_tpu.rl.module import make_module
 from ray_tpu.rl.sample_batch import SampleBatch
 
 
@@ -37,7 +37,7 @@ class PPOLearner:
         import jax.numpy as jnp
         import optax
 
-        self.module = RLModule(**module_spec)
+        self.module = make_module(module_spec)
         self.num_sgd_iter = num_sgd_iter
         self.minibatch_size = sgd_minibatch_size
         self._rng = np.random.default_rng(seed)

@@ -40,11 +40,12 @@ class RLModule:
     """Policy + value MLPs with shared-nothing towers."""
 
     def __init__(self, obs_dim: int, num_actions: int,
-                 hiddens: Sequence[int] = (64, 64)):
+                 hiddens: Sequence[int] = (64, 64), action_dim: int = 1):
         self.obs_dim = obs_dim
-        self.num_actions = num_actions   # -1 => continuous 1-D gaussian
+        self.num_actions = num_actions   # -1 => continuous gaussian
+        self.action_dim = action_dim     # continuous dims (k); discrete: n/a
         self.hiddens = tuple(hiddens)
-        self.out_dim = num_actions if num_actions > 0 else 2
+        self.out_dim = num_actions if num_actions > 0 else 2 * action_dim
 
     def init(self, key) -> Dict[str, Any]:
         kp, kv = jax.random.split(key)
@@ -59,7 +60,16 @@ class RLModule:
         value = mlp_apply(params["vf"], obs)[..., 0]
         return logits, value
 
-    # -- distribution ops (categorical / gaussian) -----------------------
+    def _mean_logstd(self, logits):
+        """Continuous head: [B, 2k] -> mean [B, k], log_std [B, k] (k=1
+        squeezes to [B] to keep 1-D env arrays unchanged)."""
+        k = self.action_dim
+        mean, log_std = logits[..., :k], logits[..., k:]
+        if k == 1:
+            mean, log_std = mean[..., 0], log_std[..., 0]
+        return mean, log_std
+
+    # -- distribution ops (categorical / diagonal gaussian) ---------------
     def sample_actions(self, params, obs, key):
         """-> (actions, logp, value) — one jitted batched call."""
         logits, value = self.apply(params, obs)
@@ -68,11 +78,12 @@ class RLModule:
             logp = jax.nn.log_softmax(logits)[
                 jnp.arange(logits.shape[0]), actions]
         else:
-            mean, log_std = logits[..., 0], logits[..., 1]
+            mean, log_std = self._mean_logstd(logits)
             eps = jax.random.normal(key, mean.shape)
             actions = mean + jnp.exp(log_std) * eps
-            logp = -0.5 * (eps ** 2 + 2 * log_std +
-                           jnp.log(2 * jnp.pi))
+            logp = -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            if logp.ndim > 1:
+                logp = logp.sum(axis=-1)   # diagonal: sum per-dim logps
         return actions, logp, value
 
     def logp_entropy(self, params, obs, actions):
@@ -84,14 +95,173 @@ class RLModule:
                             actions.astype(jnp.int32)]
             entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
         else:
-            mean, log_std = logits[..., 0], logits[..., 1]
+            mean, log_std = self._mean_logstd(logits)
             z = (actions - mean) / jnp.exp(log_std)
             logp = -0.5 * (z ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
             entropy = log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e)
+            if logp.ndim > 1:
+                logp = logp.sum(axis=-1)
+                entropy = entropy.sum(axis=-1)
         return logp, entropy, value
 
     def greedy_actions(self, params, obs):
         logits, _ = self.apply(params, obs)
         if self.num_actions > 0:
             return jnp.argmax(logits, axis=-1)
-        return logits[..., 0]
+        return self._mean_logstd(logits)[0]
+
+
+class ConvRLModule(RLModule):
+    """CNN-encoded policy/value (parity: rllib/models vision nets +
+    catalog conv_filters). Obs arrive FLATTENED [B, H*W*C] (the vectorized
+    env convention); the module reshapes internally, so collectors and
+    learners are unchanged.
+
+    filters: sequence of (out_channels, kernel, stride)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 obs_shape: Sequence[int],
+                 filters: Sequence[Sequence[int]] = ((16, 3, 2), (32, 3, 2)),
+                 hiddens: Sequence[int] = (128,), action_dim: int = 1):
+        if int(np.prod(obs_shape)) != obs_dim:
+            raise ValueError(f"obs_shape {obs_shape} != obs_dim {obs_dim}")
+        super().__init__(obs_dim, num_actions, hiddens, action_dim)
+        self.obs_shape = tuple(obs_shape)          # (H, W, C)
+        self.filters = tuple(tuple(f) for f in filters)
+
+    def _conv_out_dim(self) -> int:
+        h, w, _ = self.obs_shape
+        c = self.obs_shape[-1]
+        for (c_out, k, s) in self.filters:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            c = c_out
+        return h * w * c
+
+    def init(self, key) -> Dict[str, Any]:
+        kc, kp, kv = jax.random.split(key, 3)
+        conv = []
+        c_in = self.obs_shape[-1]
+        for (c_out, k, s) in self.filters:
+            kc, sub = jax.random.split(kc)
+            fan_in = k * k * c_in
+            conv.append({
+                "w": (jax.random.normal(sub, (k, k, c_in, c_out))
+                      * jnp.sqrt(2.0 / fan_in)).astype(jnp.float32),
+                "b": jnp.zeros(c_out, jnp.float32),
+            })
+            c_in = c_out
+        feat = self._conv_out_dim()
+        return {
+            "conv": conv,
+            "pi": mlp_init(kp, (feat, *self.hiddens, self.out_dim)),
+            "vf": mlp_init(kv, (feat, *self.hiddens, 1)),
+        }
+
+    def _encode(self, params, obs):
+        x = obs.reshape((obs.shape[0],) + self.obs_shape)
+        for layer, (_, _, s) in zip(params["conv"], self.filters):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
+            x = jax.nn.relu(x)
+        return x.reshape(x.shape[0], -1)
+
+    def apply(self, params, obs):
+        feat = self._encode(params, obs)
+        logits = mlp_apply(params["pi"], feat)
+        value = mlp_apply(params["vf"], feat)[..., 0]
+        return logits, value
+
+
+def lstm_init(key, in_dim: int, hidden: int) -> Dict[str, Any]:
+    k1, k2 = jax.random.split(key)
+    scale = jnp.sqrt(1.0 / hidden)
+    return {
+        "wx": (jax.random.normal(k1, (in_dim, 4 * hidden)) * scale
+               ).astype(jnp.float32),
+        "wh": (jax.random.normal(k2, (hidden, 4 * hidden)) * scale
+               ).astype(jnp.float32),
+        "b": jnp.zeros(4 * hidden, jnp.float32),
+    }
+
+
+def lstm_step(params, carry, x):
+    """One LSTM cell step: carry=(h, c), x [B, D]."""
+    h, c = carry
+    gates = x @ params["wx"] + h @ params["wh"] + params["b"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c), h
+
+
+class RecurrentRLModule:
+    """LSTM policy/value (parity: rllib/models recurrent nets / use_lstm).
+    Sequence-first API: apply_seq consumes [T, B, D] with an explicit
+    carried state, scanning the cell with lax.scan (TPU-friendly: one
+    compiled program per sequence length, no python-loop unrolling).
+    ``dones`` resets the carry mid-sequence so crossing episode boundaries
+    inside a rollout window is safe."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden_size: int = 64,
+                 action_dim: int = 1):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.action_dim = action_dim
+        self.hidden_size = hidden_size
+        self.out_dim = num_actions if num_actions > 0 else 2 * action_dim
+
+    def init(self, key) -> Dict[str, Any]:
+        kl, kp, kv = jax.random.split(key, 3)
+        return {
+            "lstm": lstm_init(kl, self.obs_dim, self.hidden_size),
+            "pi": mlp_init(kp, (self.hidden_size, self.out_dim)),
+            "vf": mlp_init(kv, (self.hidden_size, 1)),
+        }
+
+    def initial_state(self, batch: int):
+        z = jnp.zeros((batch, self.hidden_size), jnp.float32)
+        return (z, z)
+
+    def apply_seq(self, params, obs_seq, state, dones_seq=None):
+        """obs_seq [T, B, D], state (h, c) -> (logits [T, B, A],
+        values [T, B], final_state).
+
+        dones follows the rollout convention: dones[t]=1 means the episode
+        ended AFTER obs[t] (obs[t+1] is the reset obs) — so the carry is
+        zeroed before processing step t+1, never before step t itself."""
+        def step(carry, inp):
+            if dones_seq is None:
+                x, = inp
+            else:
+                x, d_prev = inp
+                mask = (1.0 - d_prev)[:, None]
+                carry = (carry[0] * mask, carry[1] * mask)
+            carry, h = lstm_step(params["lstm"], carry, x)
+            return carry, h
+        if dones_seq is None:
+            xs = (obs_seq,)
+        else:
+            prev = jnp.concatenate(
+                [jnp.zeros_like(dones_seq[:1]), dones_seq[:-1]], axis=0)
+            xs = (obs_seq, prev)
+        state, hs = jax.lax.scan(step, state, xs)
+        logits = mlp_apply(params["pi"], hs)
+        values = mlp_apply(params["vf"], hs)[..., 0]
+        return logits, values, state
+
+
+def make_module(spec: Dict[str, Any]):
+    """Module factory from a module_spec dict (parity: rllib catalog /
+    RLModuleSpec.build). encoder: "mlp" (default) | "cnn" | "lstm"."""
+    spec = dict(spec)
+    encoder = spec.pop("encoder", "mlp")
+    if encoder == "mlp":
+        return RLModule(**spec)
+    if encoder == "cnn":
+        return ConvRLModule(**spec)
+    if encoder == "lstm":
+        spec.pop("hiddens", None)
+        return RecurrentRLModule(**spec)
+    raise ValueError(f"unknown encoder {encoder!r}")

@@ -13,8 +13,8 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ray_tpu.rl import sample_batch as sb
-from ray_tpu.rl.env import make_env
-from ray_tpu.rl.module import RLModule
+from ray_tpu.rl.env import episode_stats_of, make_env
+from ray_tpu.rl.module import make_module
 from ray_tpu.rl.sample_batch import SampleBatch
 
 
@@ -41,7 +41,7 @@ class RolloutWorker:
                  num_envs: int, gamma: float, lam: float, seed: int = 0):
         import jax
         self.env = make_env(env, num_envs=num_envs, seed=seed)
-        self.module = RLModule(**module_spec)
+        self.module = make_module(module_spec)
         self.rollout_length = rollout_length
         self.gamma = gamma
         self.lam = lam
@@ -63,7 +63,11 @@ class RolloutWorker:
             self.params = params
         T, N = self.rollout_length, self.env.num_envs
         obs_buf = np.empty((T, N, self.env.observation_dim), np.float32)
-        act_buf = np.empty((T, N), np.float32)
+        # Continuous modules with action_dim>1 emit [N, k] actions.
+        act_dim = getattr(self.module, "action_dim", 1)
+        act_shape = (T, N) if (self.module.num_actions > 0 or act_dim == 1) \
+            else (T, N, act_dim)
+        act_buf = np.empty(act_shape, np.float32)
         rew_buf = np.empty((T, N), np.float32)
         done_buf = np.empty((T, N), np.float32)
         logp_buf = np.empty((T, N), np.float32)
@@ -90,11 +94,7 @@ class RolloutWorker:
         })
 
     def episode_stats(self) -> dict:
-        rets = getattr(self.env, "completed_returns", [])
-        if not rets:
-            return {"episode_reward_mean": float("nan"), "episodes": 0}
-        return {"episode_reward_mean": float(np.mean(rets[-100:])),
-                "episodes": len(rets)}
+        return episode_stats_of(self.env)
 
     def ping(self) -> str:
         return "pong"

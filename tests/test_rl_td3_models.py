@@ -1,0 +1,265 @@
+"""TD3 (continuous control) + RL model catalog (CNN / LSTM / multi-dim
+gaussian).
+
+Parity gates: rllib/algorithms/td3 (Pendulum learning gate, the reference's
+own tuned-example env) and rllib/models (vision + recurrent nets).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
+
+
+def test_td3_learner_delayed_actor():
+    import jax
+    from ray_tpu.rl.algorithms.td3 import TD3Learner
+
+    learner = TD3Learner({"obs_dim": 3, "num_actions": -1, "action_dim": 1},
+                         policy_delay=2, action_low=-2.0, action_high=2.0,
+                         hiddens=(32, 32), seed=0)
+    rng = np.random.default_rng(0)
+    batch = SampleBatch({
+        sb.OBS: rng.normal(size=(64, 3)).astype(np.float32),
+        sb.ACTIONS: rng.uniform(-2, 2, (64, 1)).astype(np.float32),
+        sb.REWARDS: rng.normal(size=64).astype(np.float32),
+        sb.NEXT_OBS: rng.normal(size=(64, 3)).astype(np.float32),
+        sb.DONES: rng.integers(0, 2, 64).astype(np.float32),
+    })
+    actor0 = jax.device_get(learner.params["actor"])
+    info = learner.update(batch)   # step 1: critics only (delay=2)
+    assert np.isfinite(info["critic_loss"])
+    same = jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: np.allclose(np.asarray(a), np.asarray(b)),
+        actor0, jax.device_get(learner.params["actor"])))
+    assert same, "actor updated on a non-delay step"
+    info = learner.update(batch)   # step 2: actor + target polyak
+    changed = not jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: np.allclose(np.asarray(a), np.asarray(b)),
+        actor0, jax.device_get(learner.params["actor"])))
+    assert changed, "actor never updated"
+    assert np.isfinite(info["actor_loss"])
+
+
+def test_td3_pendulum_gate(cluster):
+    """Learning gate: clear improvement over the random policy on
+    Pendulum (random ~= -1200..-1500; trained approaches -200)."""
+    from ray_tpu.rl.algorithms import TD3Config
+
+    # One worker x 8 envs x 64 steps = 512 env steps per iteration against
+    # 256 updates — the 0.5 update:sample ratio the algo is tuned at.
+    config = (TD3Config().environment("Pendulum-v1")
+              .rollouts(num_rollout_workers=1, num_envs_per_worker=8,
+                        rollout_fragment_length=64))
+    config.seed = 0
+    algo = config.build()
+    best = -1e9
+    for i in range(60):
+        result = algo.train()
+        r = result.get("episode_reward_mean")
+        if r is not None and not np.isnan(r):
+            best = max(best, r)
+        if best >= -250:
+            break
+    assert best >= -700, f"TD3 best reward {best} after {i + 1} iters"
+    # checkpoint roundtrip
+    ckpt = algo.save()
+    algo2 = config.copy().build()
+    algo2.restore(ckpt)
+    import jax
+    same = jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: np.allclose(np.asarray(a), np.asarray(b)),
+        algo.learner.params, algo2.learner.params))
+    assert same
+    algo.stop()
+
+
+def test_multidim_gaussian_module():
+    import jax
+    from ray_tpu.rl.module import RLModule
+
+    m = RLModule(obs_dim=5, num_actions=-1, hiddens=(16,), action_dim=3)
+    params = m.init(jax.random.PRNGKey(0))
+    obs = np.random.default_rng(0).normal(size=(7, 5)).astype(np.float32)
+    actions, logp, value = m.sample_actions(params, obs,
+                                            jax.random.PRNGKey(1))
+    assert actions.shape == (7, 3)
+    assert logp.shape == (7,)
+    assert value.shape == (7,)
+    lp, ent, val = m.logp_entropy(params, obs, actions)
+    assert lp.shape == (7,) and ent.shape == (7,)
+    # cross-check vs an explicit diagonal-gaussian density
+    logits, _ = m.apply(params, obs)
+    mean, log_std = np.asarray(logits[:, :3]), np.asarray(logits[:, 3:])
+    z = (np.asarray(actions) - mean) / np.exp(log_std)
+    expect = (-0.5 * (z ** 2 + 2 * log_std + np.log(2 * np.pi))).sum(-1)
+    np.testing.assert_allclose(np.asarray(lp), expect, rtol=1e-4)
+    assert np.allclose(np.asarray(m.greedy_actions(params, obs)), mean,
+                       rtol=1e-4)
+
+
+def test_conv_module_and_ppo_cnn_smoke(cluster):
+    import jax
+    from ray_tpu.rl.env import VectorEnv
+    from ray_tpu.rl.module import ConvRLModule
+
+    m = ConvRLModule(obs_dim=8 * 8 * 1, num_actions=4, obs_shape=(8, 8, 1),
+                     filters=((8, 3, 2), (16, 3, 2)), hiddens=(32,))
+    params = m.init(jax.random.PRNGKey(0))
+    obs = np.random.default_rng(0).normal(size=(5, 64)).astype(np.float32)
+    logits, value = m.apply(params, obs)
+    assert logits.shape == (5, 4) and value.shape == (5,)
+    # gradients flow through the conv stack
+    g = jax.grad(lambda p: m.logp_entropy(
+        p, obs, np.zeros(5, np.int32))[0].sum())(params)
+    gnorm = jax.tree_util.tree_reduce(
+        lambda a, b: a + b,
+        jax.tree_util.tree_map(lambda x: float(np.abs(x).sum()), g["conv"]))
+    assert gnorm > 0
+
+    class ImageToyEnv(VectorEnv):
+        """CartPole state painted into an 8x8 image (plumbing smoke)."""
+
+        def __init__(self, num_envs=4, seed=0):
+            from ray_tpu.rl.env import CartPoleVectorEnv
+            self.inner = CartPoleVectorEnv(num_envs=num_envs, seed=seed)
+            self.num_envs = num_envs
+            self.observation_dim = 64
+            self.num_actions = 2
+
+        def _paint(self, obs4):
+            img = np.zeros((obs4.shape[0], 8, 8), np.float32)
+            img[:, 0, :4] = obs4
+            img[:, 1:, :] = obs4[:, 0:1, None]
+            return img.reshape(obs4.shape[0], -1)
+
+        def vector_reset(self, seed=None):
+            return self._paint(self.inner.vector_reset(seed=seed))
+
+        def vector_step(self, actions):
+            obs, r, d, info = self.inner.vector_step(actions)
+            self.completed_returns = self.inner.completed_returns
+            return self._paint(obs), r, d, info
+
+    from ray_tpu.rl.algorithms import PPOConfig
+    config = PPOConfig().environment(
+        lambda num_envs, seed: ImageToyEnv(num_envs=num_envs, seed=seed))
+    config.num_rollout_workers = 1
+    config.num_envs_per_worker = 4
+    config.rollout_fragment_length = 16
+    config.train_batch_size = 64
+    config.model_encoder = "cnn"
+    config.model_obs_shape = (8, 8, 1)
+    config.model_filters = ((8, 3, 2), (16, 3, 2))
+    config.model_hiddens = (32,)
+    algo = config.build()
+    for _ in range(2):
+        result = algo.train()
+    assert np.isfinite(result.get("timesteps_total", 0))
+    algo.stop()
+
+
+def test_ppo_multidim_continuous_smoke(cluster):
+    """PPO end-to-end on a 2-dim Box env: the rollout buffer must carry
+    [N, k] actions (regression: act_buf was scalar-per-env)."""
+    from ray_tpu.rl.env import VectorEnv
+
+    class TwoDimEnv(VectorEnv):
+        def __init__(self, num_envs=4, seed=0):
+            self.num_envs = num_envs
+            self.observation_dim = 3
+            self.num_actions = -1
+            self.action_dim = 2
+            self._rng = np.random.default_rng(seed)
+            self._t = np.zeros(num_envs, np.int64)
+            self.completed_returns = []
+
+        def vector_reset(self, seed=None):
+            self._t[:] = 0
+            return self._rng.normal(
+                size=(self.num_envs, 3)).astype(np.float32)
+
+        def vector_step(self, actions):
+            assert np.asarray(actions).shape == (self.num_envs, 2)
+            self._t += 1
+            done = (self._t % 20 == 0).astype(np.float32)
+            r = -np.abs(np.asarray(actions)).sum(-1).astype(np.float32)
+            if done.any():
+                self.completed_returns.extend([-5.0] * int(done.sum()))
+            return (self._rng.normal(
+                size=(self.num_envs, 3)).astype(np.float32),
+                r, done, {})
+
+    from ray_tpu.rl.algorithms import PPOConfig
+    config = PPOConfig().environment(
+        lambda num_envs, seed: TwoDimEnv(num_envs=num_envs, seed=seed))
+    config.num_rollout_workers = 1
+    config.num_envs_per_worker = 4
+    config.rollout_fragment_length = 20
+    config.train_batch_size = 40
+    algo = config.build()
+    result = algo.train()
+    assert np.isfinite(result["timesteps_total"])
+    algo.stop()
+
+
+def test_lstm_module_memory_task():
+    """RecurrentRLModule learns a 12-step memory task (report the token
+    seen at t=0) — impossible without carried state."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from ray_tpu.rl.module import RecurrentRLModule
+
+    T, B, K = 12, 32, 4
+    m = RecurrentRLModule(obs_dim=K, num_actions=K, hidden_size=32)
+    params = m.init(jax.random.PRNGKey(0))
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        tok = rng.integers(0, K, B)
+        obs = np.zeros((T, B, K), np.float32)
+        obs[0, np.arange(B), tok] = 1.0   # signal only at t=0
+        return jnp.asarray(obs), jnp.asarray(tok)
+
+    @jax.jit
+    def step(params, opt, obs, tok):
+        def loss_fn(p):
+            logits, _, _ = m.apply_seq(p, obs, m.initial_state(B))
+            final = jax.nn.log_softmax(logits[-1])
+            return -jnp.mean(final[jnp.arange(B), tok])
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        upd, opt = tx.update(g, opt)
+        return optax.apply_updates(params, upd), opt, loss
+
+    obs, tok = make_batch()
+    first = float(step(params, opt, obs, tok)[2])
+    for _ in range(300):
+        obs, tok = make_batch()
+        params, opt, loss = step(params, opt, obs, tok)
+    assert float(loss) < 0.1 < first, (first, float(loss))
+    # dones reset the carry: a done at t=5 must erase the t=0 signal
+    dones = np.zeros((T, B), np.float32)
+    dones[5] = 1.0
+    logits, _, _ = m.apply_seq(params, obs, m.initial_state(B),
+                               dones_seq=jnp.asarray(dones))
+    probs = np.asarray(jax.nn.softmax(logits[-1]))
+    # post-reset the net can't know the token: near-uniform predictions
+    assert probs.max() < 0.9
