@@ -171,22 +171,32 @@ class StandardAutoscaler:
                         break
                     self.provider.create_node(tname)
                     launched[tname] = launched.get(tname, 0) + 1
-        # scale down: terminate provider nodes idle past the timeout
+        # scale down: terminate provider nodes idle past the timeout.
+        # Termination is per PROVIDER node: a multi-host TPU slice maps
+        # several cluster nodes to one provider id, and the slice may only
+        # be deleted when EVERY one of its hosts has been idle past the
+        # timeout (deleting on one idle host would kill work on the rest).
         now = time.monotonic()
         by_node_id = self.provider.node_id_map()
+        per_provider: Dict[str, List[bytes]] = {}
         for n in load["nodes"]:
             nid = n["node_id"]
             if n["is_head"] or nid not in by_node_id:
                 continue
+            per_provider.setdefault(by_node_id[nid], []).append(nid)
             idle = n["resources_available"] == n["resources_total"] and \
                 not load["demand"]
             if idle:
-                since = self._idle_since.setdefault(nid, now)
-                if now - since > self.idle_timeout_s:
-                    self.provider.terminate_node(by_node_id[nid])
-                    self._idle_since.pop(nid, None)
+                self._idle_since.setdefault(nid, now)
             else:
                 self._idle_since.pop(nid, None)
+        for provider_id, nids in per_provider.items():
+            if all(nid in self._idle_since and
+                   now - self._idle_since[nid] > self.idle_timeout_s
+                   for nid in nids):
+                self.provider.terminate_node(provider_id)
+                for nid in nids:
+                    self._idle_since.pop(nid, None)
         return launched
 
     def start(self) -> None:

@@ -217,6 +217,10 @@ def detect_slice() -> Optional[dict]:
                 or f"local-{at}")
     return {
         "slice_id": slice_id,
+        # The TPU-VM resource name (distinct from slice_id on multislice,
+        # where MEGASCALE_SLICE_ID is an index): cloud providers join on
+        # this for scale-down (autoscaler/gcp.py node_id_map).
+        "tpu_name": os.environ.get("TPU_NAME") or None,
         "accelerator_type": at,
         "generation": spec.generation,
         "worker_id": int(os.environ.get("TPU_WORKER_ID", "0") or 0),
