@@ -1,0 +1,176 @@
+"""APPO — asynchronous PPO with V-trace off-policy correction.
+
+Role parity: rllib/algorithms/appo/appo.py (APPOConfig/APPO: IMPALA's
+async sampling architecture + PPO's clipped surrogate, with V-trace
+correcting the policy lag between sampler weights and learner weights).
+TPU-first: the whole update — current-policy forward, sequence-level
+V-trace (rl/vtrace.py lax.scan), clipped surrogate, value + entropy — is
+ONE jitted step per arriving worker batch; no learner thread, the async
+loop IS the driver (Impala's pattern in impala.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.005
+        self.rho_bar = 1.0            # V-trace rho truncation
+        self.c_bar = 1.0              # V-trace c truncation
+        self.grad_clip = 0.5
+        self.algo_class = APPO
+
+
+class APPOLearner:
+    """One jitted V-trace + clipped-surrogate update per worker batch."""
+
+    def __init__(self, module_spec: dict, *, lr: float = 3e-4,
+                 clip_param: float = 0.2, vf_loss_coeff: float = 0.5,
+                 entropy_coeff: float = 0.005, gamma: float = 0.99,
+                 rho_bar: float = 1.0, c_bar: float = 1.0,
+                 grad_clip: float = 0.5, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rl.module import make_module
+        from ray_tpu.rl.vtrace import vtrace_returns
+
+        self.module = make_module(module_spec)
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                              optax.adam(lr))
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.tx.init(self.params)
+        module, tx = self.module, self.tx
+
+        def update_fn(params, opt_state, batch, last_obs):
+            T, N = batch["rewards_tn"].shape
+
+            def loss_fn(p):
+                logp, entropy, value = module.logp_entropy(
+                    p, batch[sb.OBS], batch[sb.ACTIONS])
+                logp_tn = logp.reshape(T, N)
+                value_tn = value.reshape(T, N)
+                behavior_tn = batch[sb.ACTION_LOGP].reshape(T, N)
+                # Bootstrap with the CURRENT value function so the tail
+                # target matches the in-sequence values (no stale mix).
+                bootstrap = module.apply(p, last_obs)[1]
+                vs, pg_adv = vtrace_returns(
+                    behavior_tn, logp_tn, batch["rewards_tn"],
+                    value_tn, batch["dones_tn"], bootstrap,
+                    gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
+                adv = pg_adv.reshape(-1)
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                ratio = jnp.exp(logp - batch[sb.ACTION_LOGP])
+                surr = jnp.minimum(
+                    ratio * adv,
+                    jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+                pi_loss = -surr.mean()
+                vf_loss = ((value_tn - vs) ** 2).mean()
+                ent = entropy.mean()
+                total = (pi_loss + vf_loss_coeff * vf_loss
+                         - entropy_coeff * ent)
+                return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                               "entropy": ent,
+                               "mean_rho": ratio.mean()}
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats = dict(stats)
+            stats["total_loss"] = loss
+            return params, opt_state, stats
+
+        self._update = jax.jit(update_fn)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        T, N = batch.rollout_shape
+        # Only what the loss reads goes host->device (hot async loop).
+        feed = {
+            sb.OBS: batch[sb.OBS], sb.ACTIONS: batch[sb.ACTIONS],
+            sb.ACTION_LOGP: batch[sb.ACTION_LOGP],
+            "rewards_tn": np.asarray(batch[sb.REWARDS]).reshape(T, N),
+            "dones_tn": np.asarray(batch[sb.DONES]).reshape(T, N),
+        }
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, feed, batch.last_obs)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+
+class APPO(Algorithm):
+    _default_config = APPOConfig
+
+    def setup(self) -> None:
+        cfg: APPOConfig = self.config  # type: ignore[assignment]
+        self.learner = APPOLearner(
+            self.module_spec, lr=cfg.lr, clip_param=cfg.clip_param,
+            vf_loss_coeff=cfg.vf_loss_coeff,
+            entropy_coeff=cfg.entropy_coeff, gamma=cfg.gamma,
+            rho_bar=cfg.rho_bar, c_bar=cfg.c_bar, grad_clip=cfg.grad_clip,
+            seed=cfg.seed)
+        self.workers = WorkerSet(cfg, self.module_spec)
+        self._weights_ref = self.workers.sync_weights(
+            self.learner.get_weights())
+        # Async pipeline (impala.py pattern): one STRUCTURED sample in
+        # flight per worker; v-trace absorbs the weights lag.
+        self._inflight: Dict[Any, Any] = {}
+        for w in self.workers.workers:
+            self._inflight[w.sample.remote(self._weights_ref,
+                                           structured=True)] = w
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu as rt
+        target = self.config.train_batch_size
+        count = 0
+        stats: Dict[str, float] = {}
+        while count < target:
+            ready, _ = rt.wait(list(self._inflight), num_returns=1,
+                               timeout=600)
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            batch = rt.get(ref)
+            count += batch.count
+            stats = self.learner.update(batch)
+            self._weights_ref = self.workers.sync_weights(
+                self.learner.get_weights())
+            self._inflight[worker.sample.remote(self._weights_ref,
+                                                structured=True)] = worker
+        self._timesteps_total += count
+        ep = self.workers.episode_stats()
+        means = [s["episode_reward_mean"] for s in ep if s["episodes"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means
+            else float("nan"),
+            "timesteps_total": self._timesteps_total,
+            **{f"info/{k}": v for k, v in stats.items()},
+        }
+
+    def get_state(self) -> dict:
+        return {"weights": self.learner.get_weights()}
+
+    def set_state(self, state: dict) -> None:
+        self.learner.set_weights(state["weights"])
+        self._weights_ref = self.workers.sync_weights(state["weights"])
+
+    def stop(self) -> None:
+        self.workers.stop()

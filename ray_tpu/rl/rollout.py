@@ -56,8 +56,14 @@ class RolloutWorker:
         self.params = params
         return True
 
-    def sample(self, params: Optional[Any] = None) -> SampleBatch:
-        """Collect rollout_length * num_envs transitions with GAE."""
+    def sample(self, params: Optional[Any] = None,
+               structured: bool = False) -> SampleBatch:
+        """Collect rollout_length * num_envs transitions with GAE.
+
+        structured=True skips GAE and attaches the [T, N] layout + the
+        bootstrap value as batch attributes — the learner-side V-trace
+        path (APPO/IMPALA) computes its own off-policy-corrected targets
+        from the behavior logps."""
         import jax
         if params is not None:
             self.params = params
@@ -82,16 +88,26 @@ class RolloutWorker:
             val_buf[t] = np.asarray(value)
             self.obs, rew_buf[t], done_buf[t], _ = \
                 self.env.vector_step(actions)
+        flat = lambda x: x.reshape(T * N, *x.shape[2:])
+        batch = SampleBatch({
+            sb.OBS: flat(obs_buf), sb.ACTIONS: flat(act_buf),
+            sb.REWARDS: flat(rew_buf), sb.DONES: flat(done_buf),
+            sb.ACTION_LOGP: flat(logp_buf),
+        })
+        if structured:
+            # The learner bootstraps with ITS OWN value function — ship the
+            # final observation, not a stale behavior-policy value (the lag
+            # V-trace's rho/c clipping does not correct for values).
+            batch.rollout_shape = (T, N)
+            batch.last_obs = np.asarray(self.obs, np.float32)
+            return batch
         last_value = np.asarray(self._value_fn(self.params, self.obs))
         adv, targets = compute_gae(rew_buf, val_buf, done_buf, last_value,
                                    self.gamma, self.lam)
-        flat = lambda x: x.reshape(T * N, *x.shape[2:])
-        return SampleBatch({
-            sb.OBS: flat(obs_buf), sb.ACTIONS: flat(act_buf),
-            sb.REWARDS: flat(rew_buf), sb.DONES: flat(done_buf),
-            sb.ACTION_LOGP: flat(logp_buf), sb.VF_PREDS: flat(val_buf),
-            sb.ADVANTAGES: flat(adv), sb.VALUE_TARGETS: flat(targets),
-        })
+        batch[sb.VF_PREDS] = flat(val_buf)
+        batch[sb.ADVANTAGES] = flat(adv)
+        batch[sb.VALUE_TARGETS] = flat(targets)
+        return batch
 
     def episode_stats(self) -> dict:
         return episode_stats_of(self.env)

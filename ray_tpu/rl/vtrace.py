@@ -1,0 +1,76 @@
+"""V-trace: off-policy corrected value targets (IMPALA/APPO).
+
+Role parity: rllib/algorithms/impala/vtrace.py (the reference's TF/torch
+v-trace ops). TPU-first: one lax.scan over the time axis on [T, N] arrays —
+no python loops, jit/grad-safe, batched over N envs.
+
+Math (Espeholt et al. 2018):
+    delta_t = rho_t (r_t + gamma_t V(x_{t+1}) - V(x_t))
+    vs_t    = V(x_t) + delta_t + gamma_t c_t (vs_{t+1} - V(x_{t+1}))
+    adv_t   = rho_t (r_t + gamma_t vs_{t+1} - V(x_t))
+with rho_t = min(rho_bar, pi/mu), c_t = min(c_bar, pi/mu), and gamma_t = 0
+across episode boundaries (dones).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def vtrace_returns(behavior_logp, target_logp, rewards, values, dones,
+                   bootstrap_value, *, gamma: float = 0.99,
+                   rho_bar: float = 1.0, c_bar: float = 1.0):
+    """All inputs [T, N] (bootstrap_value [N]) -> (vs [T, N], pg_adv [T, N]).
+
+    ``dones[t]=1`` means the episode ended after step t: the next state's
+    value does not flow back across the boundary.
+    """
+    log_rhos = target_logp - behavior_logp
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(rho_bar, rhos)
+    clipped_cs = jnp.minimum(c_bar, rhos)
+    discounts = gamma * (1.0 - dones)
+
+    values_next = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    # Within-trajectory bootstrap: the value AFTER a terminal step is 0 via
+    # the discount mask, so values_next needs no done handling itself.
+    deltas = clipped_rhos * (rewards + discounts * values_next - values)
+
+    def backward(carry, inp):
+        delta, disc, c, v_next_minus = inp
+        # carry = vs_{t+1} - V(x_{t+1})
+        acc = delta + disc * c * carry
+        return acc, acc
+
+    _, acc = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, clipped_cs, values_next), reverse=True)
+    vs = values + acc
+
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + discounts * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+def vtrace_reference(behavior_logp, target_logp, rewards, values, dones,
+                     bootstrap_value, *, gamma=0.99, rho_bar=1.0,
+                     c_bar=1.0):
+    """Slow numpy double-loop implementation of the same recurrences, for
+    tests only (the pattern the kernels in ops/ use for verification)."""
+    import numpy as np
+    T, N = rewards.shape
+    rhos = np.minimum(rho_bar, np.exp(target_logp - behavior_logp))
+    cs = np.minimum(c_bar, np.exp(target_logp - behavior_logp))
+    disc = gamma * (1.0 - dones)
+    v_next = np.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = rhos * (rewards + disc * v_next - values)
+    vs = np.zeros((T, N))
+    acc = np.zeros(N)
+    for t in reversed(range(T)):
+        acc = deltas[t] + disc[t] * cs[t] * acc
+        vs[t] = values[t] + acc
+    vs_next = np.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rhos * (rewards + disc * vs_next - values)
+    return vs, pg_adv
