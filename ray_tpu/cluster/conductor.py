@@ -108,6 +108,12 @@ class Conductor:
         self._log_cv = threading.Condition()
         self._log_buffer: deque = deque(maxlen=20000)
         self._log_seq = 0
+        # Structured cluster events (parity: src/ray/util/event.h + the
+        # dashboard's cluster-events table). Bounded ring; deque append is
+        # atomic so emitters may hold any other lock.
+        self._events: deque = deque(maxlen=10000)
+        self._event_seq = 0
+        self._event_lock = threading.Lock()  # seq counter, not self._lock
         if self._journal is not None:
             self._restore()
         self.server = RpcServer(self, host=host, port=port)
@@ -133,6 +139,43 @@ class Conductor:
                 self._compact_due = True
         except OSError:
             pass
+
+    def _emit_event(self, severity: str, source: str, event_type: str,
+                    message: str, **metadata) -> None:
+        """Record one structured cluster event (event.h / dashboard
+        ClusterEvents role). severity: INFO | WARNING | ERROR. Callers may
+        hold self._lock; the dedicated seq lock keeps event_ids unique
+        across concurrent RPC handler threads."""
+        with self._event_lock:
+            self._event_seq += 1
+            seq = self._event_seq
+        self._events.append({
+            "event_id": seq,
+            "timestamp": time.time(),
+            "severity": severity,
+            "source": source,
+            "event_type": event_type,
+            "message": message,
+            "metadata": metadata,
+        })
+
+    def rpc_report_event(self, severity: str, source: str, event_type: str,
+                         message: str, metadata: Optional[dict] = None
+                         ) -> None:
+        """Daemons/workers publish their events (OOM kills, job state,
+        worker crash storms) into the same stream."""
+        self._emit_event(severity, source, event_type, message,
+                         **(metadata or {}))
+
+    def rpc_list_events(self, limit: int = 1000,
+                        source: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        event_type: Optional[str] = None) -> List[dict]:
+        out = [e for e in list(self._events)
+               if (source is None or e["source"] == source)
+               and (severity is None or e["severity"] == severity)
+               and (event_type is None or e["event_type"] == event_type)]
+        return out[-limit:]
 
     def _actor_record(self, a: "ActorInfo") -> dict:
         return {"actor_id": a.actor_id, "state": a.state,
@@ -291,6 +334,10 @@ class Conductor:
             }
             self._log("node", {k: v for k, v in self._nodes[node_id].items()
                                if k != "last_heartbeat"})
+            self._emit_event(
+                "INFO", "conductor", "NODE_ADDED",
+                f"node {node_id.hex()[:8]} joined at {address}",
+                node_id=node_id.hex(), address=address, is_head=is_head)
             self._cv.notify_all()
         # A new slice host may complete a gang a pending slice PG waits on.
         with self._lock:
@@ -421,6 +468,10 @@ class Conductor:
                 return
             info["alive"] = False
             self._log("node_dead", {"node_id": node_id})
+            self._emit_event(
+                "WARNING", "conductor", "NODE_DEAD",
+                f"node {node_id.hex()[:8]} marked dead: {reason}",
+                node_id=node_id.hex(), reason=reason)
             # Drop its object locations; owners re-resolve and recover.
             for oid, locs in list(self._object_locations.items()):
                 locs.discard(node_id)
@@ -872,6 +923,12 @@ class Conductor:
                 a.state = RESTARTING
                 a.address = None
                 self._log("actor_state", self._actor_record(a))
+                self._emit_event(
+                    "WARNING", "conductor", "ACTOR_RESTARTING",
+                    f"actor {a.spec.get('class_name', '')} "
+                    f"{actor_id.hex()[:8]} restarting "
+                    f"({a.num_restarts}/{max_restarts}): {reason}",
+                    actor_id=actor_id.hex(), reason=reason)
                 self._cv.notify_all()
                 restart = True
             else:
@@ -880,6 +937,11 @@ class Conductor:
                 a.address = None
                 self._drop_name(a)
                 self._log("actor_state", self._actor_record(a))
+                self._emit_event(
+                    "ERROR", "conductor", "ACTOR_DEAD",
+                    f"actor {a.spec.get('class_name', '')} "
+                    f"{actor_id.hex()[:8]} died: {reason}",
+                    actor_id=actor_id.hex(), reason=reason)
                 self._cv.notify_all()
                 restart = False
         if restart:

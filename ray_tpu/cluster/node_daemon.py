@@ -176,6 +176,18 @@ class NodeDaemon:
                         f"retriable={victim['retriable']})"}])
         except Exception:
             pass
+        try:
+            get_client(self.conductor_address).call(
+                "report_event", severity="WARNING",
+                source=f"daemon-{self.node_id.hex()[:8]}",
+                event_type="OOM_WORKER_KILLED",
+                message=f"memory usage {usage:.2f} over threshold; killed "
+                        f"worker pid={w.pid} "
+                        f"(retriable={victim['retriable']})",
+                metadata={"pid": w.pid, "usage": usage,
+                          "retriable": victim["retriable"]})
+        except Exception:
+            pass
         self._kill_worker(w)  # reaper reports lease/actor death
 
     # ------------------------------------------------------------------
@@ -911,6 +923,17 @@ class NodeDaemon:
             status, msg = "FAILED", f"entrypoint exited with code {code}"
         self._job_update(submission_id, status=status, message=msg,
                          end_time=time.time())
+        try:
+            get_client(self.conductor_address).call(
+                "report_event",
+                severity="INFO" if status == "SUCCEEDED" else "WARNING",
+                source=f"daemon-{self.node_id.hex()[:8]}",
+                event_type=f"JOB_{status}",
+                message=f"job {submission_id} {status.lower()}"
+                        + (f": {msg}" if msg else ""),
+                metadata={"submission_id": submission_id})
+        except Exception:
+            pass
 
     def rpc_stop_job(self, submission_id: str) -> bool:
         with self._lock:

@@ -104,6 +104,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(dash.placement_groups())
             elif path == "/api/objects":
                 self._json(dash.objects())
+            elif path == "/api/events":
+                self._json(dash.events())
             elif path == "/metrics":
                 from ray_tpu.util.metrics import prometheus_text
                 self._send(prometheus_text().encode(), "text/plain")
@@ -163,6 +165,9 @@ class Dashboard:
 
     def placement_groups(self) -> list:
         return self._cli.call("list_placement_groups")
+
+    def events(self, limit: int = 500) -> list:
+        return self._cli.call("list_events", limit=limit)
 
     def objects(self) -> list:
         out = []

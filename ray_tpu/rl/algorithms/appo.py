@@ -139,29 +139,24 @@ class APPO(Algorithm):
                                            structured=True)] = w
 
     def training_step(self) -> Dict[str, Any]:
-        import ray_tpu as rt
-        target = self.config.train_batch_size
-        count = 0
-        stats: Dict[str, float] = {}
-        while count < target:
-            ready, _ = rt.wait(list(self._inflight), num_returns=1,
-                               timeout=600)
-            ref = ready[0]
-            worker = self._inflight.pop(ref)
-            batch = rt.get(ref)
-            count += batch.count
-            stats = self.learner.update(batch)
+        from ray_tpu.rl.algorithms.impala import async_training_step
+
+        def dispatch(worker):
             self._weights_ref = self.workers.sync_weights(
                 self.learner.get_weights())
             self._inflight[worker.sample.remote(self._weights_ref,
                                                 structured=True)] = worker
+
+        count, stats = async_training_step(
+            self._inflight, self.config.train_batch_size,
+            self.learner.update, dispatch)
         self._timesteps_total += count
         ep = self.workers.episode_stats()
         means = [s["episode_reward_mean"] for s in ep if s["episodes"] > 0]
         return {
             "episode_reward_mean": float(np.mean(means)) if means
             else float("nan"),
-            "timesteps_total": self._timesteps_total,
+            "num_env_steps_sampled": count,
             **{f"info/{k}": v for k, v in stats.items()},
         }
 

@@ -11,7 +11,7 @@ MultiGPULearnerThread collapses into the jitted update.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
 import numpy as np
 
@@ -19,6 +19,31 @@ from ray_tpu.rl import sample_batch as sb
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from ray_tpu.rl.learner import LearnerGroup, PPOLearner
 from ray_tpu.rl.sample_batch import SampleBatch
+
+
+def async_training_step(inflight: Dict[Any, Any], target: int, update,
+                        dispatch) -> Tuple[int, Dict[str, float]]:
+    """Shared IMPALA/APPO async driver loop (LearnerThread role): consume
+    whichever in-flight sample finishes first, update, re-dispatch that
+    worker with fresh weights. ``dispatch(worker)`` must register the
+    worker's next sample ref into ``inflight``."""
+    import ray_tpu as rt
+    count, stats = 0, {}
+    while count < target:
+        ready, _ = rt.wait(list(inflight), num_returns=1, timeout=600)
+        if not ready:
+            # Surface a real diagnosis instead of IndexError: every worker
+            # stalled past the deadline (dead daemon, hung env, ...).
+            raise TimeoutError(
+                f"no rollout batch arrived within 600s from "
+                f"{len(inflight)} in-flight rollout workers")
+        ref = ready[0]
+        worker = inflight.pop(ref)
+        batch = rt.get(ref)
+        count += batch.count
+        stats = update(batch)
+        dispatch(worker)
+    return count, stats
 
 
 class ImpalaConfig(AlgorithmConfig):
@@ -62,24 +87,14 @@ class Impala(Algorithm):
             self._inflight[w.sample.remote(self._weights_ref)] = w
 
     def training_step(self) -> Dict[str, Any]:
-        import ray_tpu as rt
-        target = self.config.train_batch_size
-        collected = []
-        count = 0
-        stats: Dict[str, float] = {}
-        while count < target:
-            ready, _ = rt.wait(list(self._inflight), num_returns=1,
-                               timeout=600)
-            ref = ready[0]
-            worker = self._inflight.pop(ref)
-            batch = rt.get(ref)
-            collected.append(batch)
-            count += batch.count
-            # async update per arriving batch (LearnerThread role)
-            stats = self.learner_group.update(batch)
+        def dispatch(worker):
             self._weights_ref = self.workers.sync_weights(
                 self.learner_group.get_weights())
             self._inflight[worker.sample.remote(self._weights_ref)] = worker
+
+        count, stats = async_training_step(
+            self._inflight, self.config.train_batch_size,
+            self.learner_group.update, dispatch)
         self._timesteps_total += count
         ep = self.workers.episode_stats()
         means = [s["episode_reward_mean"] for s in ep if s["episodes"] > 0]

@@ -134,7 +134,8 @@ def cmd_list(args) -> None:
     from ray_tpu import state
     fn = {"actors": state.list_actors, "tasks": state.list_tasks,
           "nodes": state.list_nodes, "objects": state.list_objects,
-          "placement-groups": state.list_placement_groups}[args.entity]
+          "placement-groups": state.list_placement_groups,
+          "events": state.list_cluster_events}[args.entity]
     print(json.dumps(fn(), indent=2, default=str))
 
 
@@ -278,7 +279,7 @@ def main(argv=None) -> None:
 
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument("entity", choices=["actors", "tasks", "nodes", "objects",
-                                      "placement-groups"])
+                                      "placement-groups", "events"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
 

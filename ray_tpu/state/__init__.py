@@ -5,9 +5,11 @@ list_nodes, list_objects, list_placement_groups, summarize_tasks) backed by
 the conductor's tables (the role of GCS + dashboard/state_aggregator.py).
 """
 
-from ray_tpu.state.api import (list_actors, list_nodes, list_objects,
+from ray_tpu.state.api import (list_actors, list_cluster_events,
+                               list_nodes, list_objects,
                                list_placement_groups, list_tasks,
                                summarize_tasks)
 
 __all__ = ["list_actors", "list_tasks", "list_nodes", "list_objects",
-           "list_placement_groups", "summarize_tasks"]
+           "list_placement_groups", "list_cluster_events",
+           "summarize_tasks"]

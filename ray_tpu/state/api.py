@@ -78,3 +78,13 @@ def summarize_tasks() -> Dict[str, dict]:
     for row in agg.values():
         row["mean_time_s"] = row["total_time_s"] / max(1, row["count"])
     return dict(agg)
+
+
+def list_cluster_events(limit: int = 1000, source: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        event_type: Optional[str] = None) -> List[dict]:
+    """Structured cluster events (parity: `ray list cluster-events` /
+    dashboard ClusterEvents): node membership, actor FSM transitions, OOM
+    kills, job state changes."""
+    return _conductor().call("list_events", limit=limit, source=source,
+                             severity=severity, event_type=event_type)

@@ -1,0 +1,100 @@
+"""Structured cluster events (src/ray/util/event.h + dashboard
+ClusterEvents role): node membership, actor FSM transitions, job state."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.cluster.protocol import get_client
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
+
+
+def _events(addr, **kw):
+    return get_client(addr).call("list_events", **kw)
+
+
+def test_node_and_actor_events(cluster):
+    evs = _events(cluster.address)
+    assert any(e["event_type"] == "NODE_ADDED" for e in evs)
+
+    node2 = cluster.add_node(num_cpus=1)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if sum(e["event_type"] == "NODE_ADDED"
+               for e in _events(cluster.address)) >= 2:
+            break
+        time.sleep(0.1)
+    cluster.remove_node(node2, graceful=False)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if any(e["event_type"] == "NODE_DEAD"
+               for e in _events(cluster.address)):
+            break
+        time.sleep(0.2)
+    dead = [e for e in _events(cluster.address)
+            if e["event_type"] == "NODE_DEAD"]
+    assert dead and dead[0]["severity"] == "WARNING"
+    assert "reason" in dead[0]["metadata"]
+
+    # actor death event carries the class name
+    @ray_tpu.remote(max_restarts=0)
+    class Crasher:
+        def die(self):
+            import os
+            os._exit(1)
+
+    a = Crasher.remote()
+    ref = a.die.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=30)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if any(e["event_type"] == "ACTOR_DEAD"
+               for e in _events(cluster.address)):
+            break
+        time.sleep(0.2)
+    dead = [e for e in _events(cluster.address)
+            if e["event_type"] == "ACTOR_DEAD"]
+    assert dead and "Crasher" in dead[0]["message"]
+
+    # severity filter
+    warns = _events(cluster.address, severity="ERROR")
+    assert warns and all(e["severity"] == "ERROR" for e in warns)
+
+    # state API surface
+    from ray_tpu import state
+    evs = state.list_cluster_events(event_type="NODE_ADDED")
+    assert evs and all(e["event_type"] == "NODE_ADDED" for e in evs)
+
+
+def test_job_events(cluster):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(cluster.address)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('evt')\"")
+    client.wait_until_finish(sid, timeout=60)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if any(e["event_type"] == "JOB_SUCCEEDED"
+               for e in _events(cluster.address)):
+            break
+        time.sleep(0.2)
+    evs = [e for e in _events(cluster.address)
+           if e["event_type"] == "JOB_SUCCEEDED"]
+    assert evs and evs[0]["metadata"]["submission_id"] == sid
