@@ -326,6 +326,29 @@ class Dataset:
                     yield format_batch(carry, batch_format)
                 return
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           prefetch_blocks: int = 2,
+                           drop_last: bool = False,
+                           dtypes=None) -> Iterator[Any]:
+        """Batches as dicts of torch tensors (parity:
+        python/ray/data/iterator.py iter_torch_batches). Tensors are
+        zero-copy views of the numpy batch where dtypes allow."""
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       prefetch_blocks=prefetch_blocks,
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(v)
+                if dtypes is not None:
+                    want = dtypes.get(k) if isinstance(dtypes, dict) \
+                        else dtypes
+                    if want is not None:
+                        t = t.to(want)
+                out[k] = t
+            yield out
+
     def iter_rows(self) -> Iterator[dict]:
         import ray_tpu as rt
         for ref in self.iter_block_refs():

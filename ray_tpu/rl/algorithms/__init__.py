@@ -2,8 +2,9 @@ from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rl.algorithms.impala import Impala, ImpalaConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
-from ray_tpu.rl.algorithms.td3 import TD3, TD3Config
+from ray_tpu.rl.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
 
 __all__ = ["PPO", "PPOConfig", "Impala", "ImpalaConfig", "DQN", "DQNConfig",
-           "SAC", "SACConfig", "TD3", "TD3Config", "APPO", "APPOConfig"]
+           "SAC", "SACConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
+           "APPO", "APPOConfig"]

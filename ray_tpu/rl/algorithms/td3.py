@@ -43,6 +43,7 @@ class TD3Config(AlgorithmConfig):
         self.target_noise = 0.2         # target policy smoothing sigma
         self.target_noise_clip = 0.5
         self.exploration_noise = 0.1    # rollout gaussian sigma (action units)
+        self.twin_q = True
         self.algo_class = TD3
 
 
@@ -55,7 +56,7 @@ class TD3Learner:
                  tau: float = 0.005, policy_delay: int = 2,
                  target_noise: float = 0.2, target_noise_clip: float = 0.5,
                  action_low: float = -1.0, action_high: float = 1.0,
-                 hiddens=(64, 64), seed: int = 0):
+                 hiddens=(64, 64), twin_q: bool = True, seed: int = 0):
         import jax
         import jax.numpy as jnp
         import optax
@@ -114,14 +115,20 @@ class TD3Learner:
                 -target_noise_clip * scale, target_noise_clip * scale)
             a_next = jnp.clip(act(target["actor"], next_obs) + noise,
                               action_low, action_high)
-            q_next = jnp.minimum(q_val(target["q1"], next_obs, a_next),
-                                 q_val(target["q2"], next_obs, a_next))
+            if twin_q:
+                q_next = jnp.minimum(
+                    q_val(target["q1"], next_obs, a_next),
+                    q_val(target["q2"], next_obs, a_next))
+            else:  # DDPG: single critic
+                q_next = q_val(target["q1"], next_obs, a_next)
             td_target = jax.lax.stop_gradient(
                 rew + gamma * (1.0 - dones) * q_next)
 
             def critic_loss(qps):
                 l1 = jnp.mean((q_val(qps["q1"], obs, actions) - td_target)
                               ** 2)
+                if not twin_q:
+                    return l1
                 l2 = jnp.mean((q_val(qps["q2"], obs, actions) - td_target)
                               ** 2)
                 return l1 + l2
@@ -241,7 +248,8 @@ class TD3(Algorithm):
             target_noise=cfg.target_noise,
             target_noise_clip=cfg.target_noise_clip,
             action_low=probe.action_low, action_high=probe.action_high,
-            hiddens=tuple(cfg.model_hiddens), seed=cfg.seed)
+            hiddens=tuple(cfg.model_hiddens), twin_q=cfg.twin_q,
+            seed=cfg.seed)
         self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
         collector_cls = rt.remote(TD3Collector)
         self.collectors = [
@@ -288,3 +296,20 @@ class TD3(Algorithm):
         self.learner.set_state(state["learner"])
         self._timesteps_total = state["timesteps_total"]
         self.iteration = state["iteration"]
+
+
+class DDPGConfig(TD3Config):
+    """DDPG (parity: rllib/algorithms/ddpg) — TD3's degenerate point:
+    single critic, no delay, no target smoothing."""
+
+    def __init__(self):
+        super().__init__()
+        self.twin_q = False
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        self.target_noise_clip = 0.0
+        self.algo_class = DDPG
+
+
+class DDPG(TD3):
+    _default_config = DDPGConfig

@@ -168,8 +168,8 @@ class BCConfig:
             setattr(self, k, v)
         return self
 
-    def build(self) -> "BC":
-        return BC(self)
+    def build(self):
+        return self.algo_class(self)
 
 
 class BC:
@@ -225,6 +225,7 @@ class BC:
         return {k: float(v) for k, v in stats.items()} | {
             "training_iteration": self.iteration}
 
+    # (MARWIL below reuses this BC eval verbatim via inheritance.)
     def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
         """Greedy rollout of the cloned policy on the live env."""
         import jax
@@ -239,3 +240,78 @@ class BC:
             obs, _, _, _ = venv.vector_step(actions)
         returns = venv.completed_returns[:num_episodes]
         return {"episode_reward_mean": float(np.mean(returns))}
+
+
+class MARWILConfig(BCConfig):
+    """MARWIL config (parity: rllib/algorithms/marwil/marwil.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0          # advantage temperature (0 => plain BC)
+        self.vf_coeff = 1.0
+        self.gamma = 0.99
+        self.algo_class = MARWIL
+
+
+class MARWIL(BC):
+    """Monotonic advantage re-weighted imitation learning.
+
+    Role parity: rllib/algorithms/marwil — BC where each transition's
+    log-prob is weighted by exp(beta * A_norm); a value tower learns
+    one-step TD targets from the offline transitions (the dataset is
+    shuffled transitions, so the advantage is the one-step
+    r + gamma*V(s') - V(s) rather than the trajectory Monte-Carlo form).
+    beta=0 reduces exactly to BC. One jitted update per batch.
+    """
+
+    def __init__(self, config: "MARWILConfig"):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        super().__init__(config)  # builds module/params/tx + BC step
+        beta, vf_coeff, gamma = config.beta, config.vf_coeff, config.gamma
+        module, tx = self.module, self.tx
+
+        def loss_fn(params, batch):
+            logp, entropy, value = module.logp_entropy(
+                params, batch[sb.OBS], batch[sb.ACTIONS])
+            v_next = module.apply(params, batch[sb.NEXT_OBS])[1]
+            td_target = jax.lax.stop_gradient(
+                batch[sb.REWARDS] + gamma * (1.0 - batch[sb.DONES]) * v_next)
+            adv = jax.lax.stop_gradient(td_target - value)
+            adv_norm = adv / (jnp.std(adv) + 1e-8)
+            weights = jnp.exp(jnp.clip(beta * adv_norm, -10.0, 10.0))
+            pi_loss = -(weights * logp).mean()
+            vf_loss = ((value - td_target) ** 2).mean()
+            total = pi_loss + vf_coeff * vf_loss
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "mean_weight": weights.mean(),
+                           "entropy": entropy.mean()}
+
+        def step(params, opt_state, batch):
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats = dict(stats)
+            stats["total_loss"] = loss
+            return params, opt_state, stats
+
+        self._step = jax.jit(step)
+
+    def train(self) -> Dict[str, Any]:
+        stats: Dict[str, Any] = {}
+        for _ in range(self.config.updates_per_iter):
+            batch = self.reader.sample(self.config.train_batch_size)
+            batch = SampleBatch({
+                sb.OBS: np.asarray(batch[sb.OBS], np.float32),
+                sb.ACTIONS: np.asarray(batch[sb.ACTIONS]),
+                sb.REWARDS: np.asarray(batch[sb.REWARDS], np.float32),
+                sb.NEXT_OBS: np.asarray(batch[sb.NEXT_OBS], np.float32),
+                sb.DONES: np.asarray(batch[sb.DONES], np.float32)})
+            self.params, self.opt_state, stats = self._step(
+                self.params, self.opt_state, dict(batch))
+        self.iteration += 1
+        return {k: float(v) for k, v in stats.items()} | {
+            "training_iteration": self.iteration}

@@ -24,6 +24,9 @@ _LAZY = {
     "session": ("ray_tpu.air", "session"),
     "report": ("ray_tpu.air.session", "report"),
     "JaxTrainer": ("ray_tpu.train.trainer", "JaxTrainer"),
+    "TorchTrainer": ("ray_tpu.train.trainer", "TorchTrainer"),
+    "TorchBackend": ("ray_tpu.train.backend_executor", "TorchBackend"),
+    "torch_utils": ("ray_tpu.train.torch_utils", None),
     "DataParallelTrainer": ("ray_tpu.train.trainer", "DataParallelTrainer"),
     "BaseTrainer": ("ray_tpu.train.trainer", "BaseTrainer"),
     "BackendExecutor": ("ray_tpu.train.backend_executor", "BackendExecutor"),
@@ -43,4 +46,5 @@ def __getattr__(name):
     if entry is None:
         raise AttributeError(name)
     import importlib
-    return getattr(importlib.import_module(entry[0]), entry[1])
+    mod = importlib.import_module(entry[0])
+    return mod if entry[1] is None else getattr(mod, entry[1])

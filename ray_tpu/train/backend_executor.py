@@ -71,6 +71,57 @@ class JaxBackend(Backend):
         rt.get(refs, timeout=120)
 
 
+def _init_torch_distributed(master_addr: str, master_port: int,
+                            world_size: int, rank: int,
+                            backend: str = "gloo") -> bool:
+    import os
+
+    import torch.distributed as dist
+    if dist.is_initialized():
+        return True
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    # gloo default: the CPU-host collective backend (the reference's
+    # non-GPU path, train/torch/config.py backend="gloo"); TPU-side math
+    # never goes through torch — this exists for torch data/eval loops.
+    dist.init_process_group(backend, rank=rank, world_size=world_size)
+    return True
+
+
+class TorchBackend(Backend):
+    """torch.distributed rendezvous over the gang (parity:
+    train/torch/config.py:113 _TorchBackend.on_start). The group is
+    initialized even at world size 1 so loops using torch.distributed
+    APIs behave identically in debug (1-worker) runs."""
+
+    def __init__(self, backend: str = "gloo"):
+        self.backend_name = backend
+
+    def on_start(self, worker_group: WorkerGroup) -> None:
+        ip = worker_group.execute_single(
+            0, lambda: socket.gethostbyname(socket.gethostname()))
+        port = worker_group.execute_single(0, _free_port)
+        import ray_tpu as rt
+        refs = [
+            w.execute.remote(_init_torch_distributed, ip, port,
+                             worker_group.num_workers, rank,
+                             self.backend_name)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        rt.get(refs, timeout=120)
+
+    def on_shutdown(self, worker_group: WorkerGroup) -> None:
+        def _destroy():
+            import torch.distributed as dist
+            if dist.is_initialized():
+                dist.destroy_process_group()
+            return True
+        try:
+            worker_group.execute(_destroy)
+        except Exception:
+            pass  # workers may already be gone
+
+
 class TrainingFailedError(RuntimeError):
     pass
 

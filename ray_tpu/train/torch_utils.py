@@ -1,0 +1,42 @@
+"""torch conveniences for TorchTrainer loops.
+
+Role parity: python/ray/train/torch/train_loop_utils.py — prepare_model
+(DDP wrap), prepare_data_loader (DistributedSampler), get_device. CPU/gloo
+only in this framework: torch is the host-side data/eval path; accelerator
+math belongs to the jax/pjit stack (JaxTrainer)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def get_device():
+    import torch
+    return torch.device("cpu")
+
+
+def prepare_model(model):
+    """Wrap in DistributedDataParallel when a process group is live."""
+    import torch.distributed as dist
+    if dist.is_available() and dist.is_initialized() and \
+            dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Re-build the loader with a DistributedSampler sharding per rank."""
+    import torch.distributed as dist
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+    sampler = DistributedSampler(data_loader.dataset)
+    return DataLoader(data_loader.dataset,
+                      batch_size=data_loader.batch_size,
+                      sampler=sampler,
+                      num_workers=0,
+                      collate_fn=data_loader.collate_fn,
+                      drop_last=data_loader.drop_last)

@@ -191,3 +191,15 @@ class JaxTrainer(DataParallelTrainer):
         super().__init__(train_loop_per_worker,
                          backend=JaxBackend(distributed=distributed),
                          **kwargs)
+
+
+class TorchTrainer(DataParallelTrainer):
+    """torch loops in the gang (parity: torch/torch_trainer.py:15): a gloo
+    process group spans the workers; train.torch_utils.prepare_model /
+    prepare_data_loader give DDP + per-rank sharding. Host-CPU only here —
+    accelerator math is the jax stack's job (JaxTrainer)."""
+
+    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+        from ray_tpu.train.backend_executor import TorchBackend
+        super().__init__(train_loop_per_worker, backend=TorchBackend(),
+                         **kwargs)
