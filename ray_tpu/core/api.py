@@ -87,6 +87,17 @@ def is_initialized() -> bool:
 def _global_runtime():
     global _runtime
     if _runtime is None:
+        # Implicit init (reference parity: ray.get before ray.init starts a
+        # local cluster) — but only from the MAIN thread. A background
+        # thread reaching here is a straggler touching the API after
+        # shutdown(); silently booting a fresh local cluster from it leaks
+        # a runtime the real driver then trips over ("init called twice")
+        # and burns CPU behind the user's back.
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "ray_tpu is not initialized (implicit init is "
+                "main-thread-only; was the API called from a background "
+                "thread after shutdown()?)")
         init()
     return _runtime
 

@@ -4,7 +4,9 @@ from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 from ray_tpu.rl.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rl.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
 
 __all__ = ["PPO", "PPOConfig", "Impala", "ImpalaConfig", "DQN", "DQNConfig",
            "SAC", "SACConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
-           "APPO", "APPOConfig"]
+           "APPO", "APPOConfig", "A2C", "A2CConfig", "CQL", "CQLConfig"]

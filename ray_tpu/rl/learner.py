@@ -98,9 +98,14 @@ class PPOLearner:
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         """Minibatch-SGD epochs over one train batch."""
         stats = {}
+        # 0 => whole batch; larger-than-batch clamps down — minibatches()
+        # yields NOTHING when size > count, which would silently skip the
+        # update (a real A2C bug class, not a safe no-op).
+        size = self.minibatch_size or batch.count
+        size = min(size, batch.count)
         for _ in range(self.num_sgd_iter):
             shuffled = batch.shuffle(self._rng)
-            for mb in shuffled.minibatches(self.minibatch_size):
+            for mb in shuffled.minibatches(size):
                 self.params, self.opt_state, stats = self._sgd(
                     self.params, self.opt_state, dict(mb))
         return {k: float(v) for k, v in stats.items()}

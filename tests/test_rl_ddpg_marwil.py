@@ -8,8 +8,22 @@ import tempfile
 import numpy as np
 import pytest
 
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
 from ray_tpu.rl import sample_batch as sb
 from ray_tpu.rl.sample_batch import SampleBatch
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
 
 
 def _batch(rng, n=64):
@@ -85,3 +99,47 @@ def test_marwil_weights_and_learning():
           .training(updates_per_iter=5, beta=0.0)).build()
     stats0 = m0.train()
     assert abs(stats0["mean_weight"] - 1.0) < 1e-5
+
+
+def test_a2c_reduction_and_learning(cluster):
+    """A2C == PPO at (1 SGD pass, clip inert); short learning smoke."""
+    from ray_tpu.rl.algorithms import A2C, A2CConfig
+
+    cfg = A2CConfig()
+    assert cfg.num_sgd_iter == 1 and cfg.algo_class is A2C
+    cfg = (A2CConfig().environment("CartPole-v1")
+           .rollouts(num_rollout_workers=1, num_envs_per_worker=8,
+                     rollout_fragment_length=32))
+    cfg.train_batch_size = 256
+    algo = cfg.build()
+    best = 0.0
+    for _ in range(35):
+        r = algo.train().get("episode_reward_mean")
+        if r is not None and not np.isnan(r):
+            best = max(best, r)
+        if best >= 60:
+            break
+    # CartPole's RANDOM policy scores ~22; >= 40 demands actual learning.
+    assert best >= 40, f"A2C best reward {best}"
+    algo.stop()
+
+
+def test_cql_offline_gate():
+    """CQL trains purely offline and beats random on CartPole; the
+    conservative penalty keeps dataset-action Q above logsumexp gap."""
+    from ray_tpu.rl.algorithms import CQLConfig
+    from ray_tpu.rl.offline import collect_experiences
+
+    path = tempfile.mkdtemp()
+    collect_experiences(
+        "CartPole-v1", path, num_steps=4000, seed=0,
+        policy_fn=lambda obs: (obs[:, 2] + 0.5 * obs[:, 3] > 0).astype(int))
+
+    cql = (CQLConfig().offline_data(input_path=path)
+           .training(updates_per_iter=200, lr=5e-4, alpha=1.0)).build()
+    for _ in range(5):
+        stats = cql.train()
+    assert np.isfinite(stats["total_loss"])
+    assert stats["cql_loss"] >= 0  # logsumexp >= Q(a_data) pointwise mean
+    ev = cql.evaluate(num_episodes=10)
+    assert ev["episode_reward_mean"] >= 60, f"CQL policy too weak: {ev}"
