@@ -53,6 +53,10 @@ class ConnectionLost(RpcError):
     pass
 
 
+class _PooledSocketDead(RpcError):
+    """Internal: a cached keep-alive socket failed; retry on a fresh one."""
+
+
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(struct.pack("<I", len(payload)) + payload)
 
@@ -166,8 +170,14 @@ class RpcClient:
     ``reconnect_s`` > 0 makes calls retry connection-level failures for up
     to that many seconds — the failover transparency window (a restarted
     conductor comes back on the same port; parity: the reference's GCS RPC
-    client reconnection, gcs_rpc_client.h). Retries are at-least-once:
-    non-idempotent services dedupe (e.g. ref_update batch ids).
+    client reconnection, gcs_rpc_client.h).
+
+    Delivery contract: AT-LEAST-ONCE for every client. Independent of
+    reconnect_s, a call whose POOLED keep-alive socket turns out dead is
+    re-sent once on a fresh connection (ports get reused; a cached socket
+    can point at a long-gone server). Services are designed for this:
+    control-plane mutations are idempotent or dedupe by id (ref_update
+    batch ids, actor push seqnos, task ids, lease ids).
     """
 
     def __init__(self, address: str, timeout: Optional[float] = None,
@@ -189,9 +199,33 @@ class RpcClient:
     def call(self, method: str, _timeout: Optional[float] = None, **kwargs) -> Any:
         deadline = (time.monotonic() + self._reconnect_s
                     if self._reconnect_s > 0 else None)
+        fresh_retry_done = False
         while True:
             try:
                 return self._call_once(method, _timeout, kwargs)
+            except _PooledSocketDead as e:
+                # A POOLED socket died under us. Ports get reused: the
+                # process-wide client cache (get_client) can hold sockets
+                # to a long-gone server whose host:port a NEW server now
+                # owns (observed as cross-test flakes; same hazard as a
+                # same-port conductor failover). Its pool-mates are stale
+                # too — drop them all and retry once on a FRESH
+                # connection; further failures follow the normal
+                # reconnect-deadline policy.
+                with self._lock:
+                    stale, self._free = self._free, []
+                for s in stale:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                if not fresh_retry_done:
+                    fresh_retry_done = True
+                    continue
+                if deadline is None or time.monotonic() >= deadline or \
+                        self._closed:
+                    raise ConnectionLost("connection closed") from e
+                time.sleep(0.1)
             except (ConnectionLost, ConnectionRefusedError,
                     ConnectionResetError, BrokenPipeError, OSError):
                 if deadline is None or time.monotonic() >= deadline or \
@@ -203,6 +237,7 @@ class RpcClient:
                    kwargs: dict) -> Any:
         with self._lock:
             sock = self._free.pop() if self._free else None
+        pooled = sock is not None
         if sock is None:
             sock = self._connect()
         try:
@@ -212,11 +247,14 @@ class RpcClient:
             ok, payload = pickle.loads(_recv_frame(sock))
             if _timeout is not None:
                 sock.settimeout(self._timeout)
-        except BaseException:
+        except BaseException as e:
             try:
                 sock.close()
             except OSError:
                 pass
+            if pooled and isinstance(e, (ConnectionLost, ConnectionError,
+                                         BrokenPipeError)):
+                raise _PooledSocketDead() from e
             raise
         with self._lock:
             if self._closed:

@@ -98,6 +98,8 @@ class PPOLearner:
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         """Minibatch-SGD epochs over one train batch."""
         stats = {}
+        if batch.count == 0:
+            return stats  # faulted rollout round: nothing to learn from
         # 0 => whole batch; larger-than-batch clamps down — minibatches()
         # yields NOTHING when size > count, which would silently skip the
         # update (a real A2C bug class, not a safe no-op).

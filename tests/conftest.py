@@ -31,3 +31,20 @@ def local_rt():
     ray_tpu.init(local_mode=True, num_cpus=4)
     yield ray_tpu
     ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def cluster8():
+    """Shared module-scoped 8-CPU cluster + connected driver runtime (the
+    common fixture for RL/train suites; avoid re-copying it per file)."""
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.core import api as core_api
+    from ray_tpu.core.runtime_cluster import ClusterRuntime
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()

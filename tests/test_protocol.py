@@ -1,0 +1,64 @@
+"""RPC protocol layer (grpc_server/client role).
+
+Focus: pooled keep-alive socket staleness — the client cache is keyed by
+address, and ports get reused (a new server binding a dead server's
+host:port must be transparently reachable through the cached client; same
+mechanism serves same-port conductor failover)."""
+
+import pytest
+
+from ray_tpu.cluster import protocol
+from ray_tpu.cluster.protocol import RpcClient, RpcServer
+
+
+class _Svc:
+    def __init__(self, tag):
+        self.tag = tag
+
+    def rpc_whoami(self):
+        return self.tag
+
+    def rpc_echo(self, x):
+        return x
+
+
+def test_pooled_socket_survives_server_replacement():
+    s1 = RpcServer(_Svc("first"))
+    cli = RpcClient(s1.address)
+    assert cli.call("whoami") == "first"   # pools a keep-alive socket
+    port = int(s1.address.rsplit(":", 1)[1])
+    s1.stop()
+    # New server, SAME port — the cached socket is now stale.
+    s2 = RpcServer(_Svc("second"), port=port)
+    try:
+        assert cli.call("whoami") == "second"  # fresh-socket retry
+    finally:
+        s2.stop()
+        cli.close()
+
+
+def test_dead_server_still_raises():
+    s = RpcServer(_Svc("x"))
+    cli = RpcClient(s.address)
+    assert cli.call("echo", x=5) == 5
+    s.stop()
+    with pytest.raises((protocol.ConnectionLost, ConnectionError, OSError)):
+        cli.call("echo", x=6)   # nothing listening: fail, don't loop
+    cli.close()
+
+
+def test_error_propagation_and_unknown_method():
+    class Boom:
+        def rpc_kaboom(self):
+            raise ValueError("inner detail")
+
+    s = RpcServer(Boom())
+    cli = RpcClient(s.address)
+    try:
+        with pytest.raises(ValueError, match="inner detail"):
+            cli.call("kaboom")
+        with pytest.raises(protocol.RpcError, match="no such method"):
+            cli.call("nope")
+    finally:
+        s.stop()
+        cli.close()

@@ -12,17 +12,6 @@ from ray_tpu.core import api as core_api
 from ray_tpu.core.runtime_cluster import ClusterRuntime
 
 
-@pytest.fixture(scope="module")
-def cluster():
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
-    rt_ = ClusterRuntime(address=c.address)
-    core_api._runtime = rt_
-    yield c
-    core_api._runtime = None
-    rt_.shutdown()
-    c.shutdown()
-
-
 def test_vtrace_matches_reference():
     from ray_tpu.rl.vtrace import vtrace_reference, vtrace_returns
 
@@ -69,7 +58,7 @@ def test_vtrace_on_policy_reduces_to_returns():
     np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-5)
 
 
-def test_structured_sample_roundtrip(cluster):
+def test_structured_sample_roundtrip(cluster8):
     """Batch attributes (rollout_shape, bootstrap_value) survive the
     object plane — V-trace's layout rides on the SampleBatch."""
     import ray_tpu as rt
@@ -89,7 +78,7 @@ def test_structured_sample_roundtrip(cluster):
     assert np.allclose(back.last_obs, batch.last_obs)
 
 
-def test_appo_cartpole_gate(cluster):
+def test_appo_cartpole_gate(cluster8):
     """Learning gate: APPO reaches reward >= 150 on CartPole within a
     CI-sized budget (rllib tuned-example role)."""
     from ray_tpu.rl.algorithms import APPOConfig

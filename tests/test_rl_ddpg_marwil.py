@@ -15,17 +15,6 @@ from ray_tpu.rl import sample_batch as sb
 from ray_tpu.rl.sample_batch import SampleBatch
 
 
-@pytest.fixture(scope="module")
-def cluster():
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
-    rt_ = ClusterRuntime(address=c.address)
-    core_api._runtime = rt_
-    yield c
-    core_api._runtime = None
-    rt_.shutdown()
-    c.shutdown()
-
-
 def _batch(rng, n=64):
     return SampleBatch({
         sb.OBS: rng.normal(size=(n, 3)).astype(np.float32),
@@ -101,7 +90,7 @@ def test_marwil_weights_and_learning():
     assert abs(stats0["mean_weight"] - 1.0) < 1e-5
 
 
-def test_a2c_reduction_and_learning(cluster):
+def test_a2c_reduction_and_learning(cluster8):
     """A2C == PPO at (1 SGD pass, clip inert); short learning smoke."""
     from ray_tpu.rl.algorithms import A2C, A2CConfig
 

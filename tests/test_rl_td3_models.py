@@ -15,17 +15,6 @@ from ray_tpu.rl import sample_batch as sb
 from ray_tpu.rl.sample_batch import SampleBatch
 
 
-@pytest.fixture(scope="module")
-def cluster():
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
-    rt_ = ClusterRuntime(address=c.address)
-    core_api._runtime = rt_
-    yield c
-    core_api._runtime = None
-    rt_.shutdown()
-    c.shutdown()
-
-
 def test_td3_learner_delayed_actor():
     import jax
     from ray_tpu.rl.algorithms.td3 import TD3Learner
@@ -56,7 +45,7 @@ def test_td3_learner_delayed_actor():
     assert np.isfinite(info["actor_loss"])
 
 
-def test_td3_pendulum_gate(cluster):
+def test_td3_pendulum_gate(cluster8):
     """Learning gate: clear improvement over the random policy on
     Pendulum (random ~= -1200..-1500; trained approaches -200)."""
     from ray_tpu.rl.algorithms import TD3Config
@@ -113,7 +102,7 @@ def test_multidim_gaussian_module():
                        rtol=1e-4)
 
 
-def test_conv_module_and_ppo_cnn_smoke(cluster):
+def test_conv_module_and_ppo_cnn_smoke(cluster8):
     import jax
     from ray_tpu.rl.env import VectorEnv
     from ray_tpu.rl.module import ConvRLModule
@@ -174,7 +163,7 @@ def test_conv_module_and_ppo_cnn_smoke(cluster):
     algo.stop()
 
 
-def test_ppo_multidim_continuous_smoke(cluster):
+def test_ppo_multidim_continuous_smoke(cluster8):
     """PPO end-to-end on a 2-dim Box env: the rollout buffer must carry
     [N, k] actions (regression: act_buf was scalar-per-env)."""
     from ray_tpu.rl.env import VectorEnv

@@ -12,18 +12,7 @@ from ray_tpu.core import api as core_api
 from ray_tpu.core.runtime_cluster import ClusterRuntime
 
 
-@pytest.fixture(scope="module")
-def cluster():
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
-    rt_ = ClusterRuntime(address=c.address)
-    core_api._runtime = rt_
-    yield c
-    core_api._runtime = None
-    rt_.shutdown()
-    c.shutdown()
-
-
-def test_torch_trainer_ddp(cluster):
+def test_torch_trainer_ddp(cluster8):
     from ray_tpu.air.config import ScalingConfig
     from ray_tpu.train.trainer import TorchTrainer
 
@@ -77,7 +66,7 @@ def test_torch_trainer_ddp(cluster):
     assert result.metrics["param_sync_err"] < 1e-6
 
 
-def test_iter_torch_batches(cluster):
+def test_iter_torch_batches(cluster8):
     import torch
 
     from ray_tpu import data
