@@ -6,6 +6,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cerrno>
 #include <cstring>
 
@@ -135,8 +136,13 @@ Value Client::Call(const std::string& method,
 }
 
 ObjectRef Client::Put(const Value& value) {
+  // put_id makes the call idempotent under the RPC layer's at-least-once
+  // delivery (ray_tpu/client/server.py rpc_cp_put dedupe).
+  static std::atomic<uint64_t> counter{0};
+  std::string put_id = session_ + "-" + std::to_string(++counter);
   Value resp = Call("cp_put",
-                    {{Value::Str("blob"), Value::Bytes(PickleDumps(value))}});
+                    {{Value::Str("blob"), Value::Bytes(PickleDumps(value))},
+                     {Value::Str("put_id"), Value::Str(put_id)}});
   return RefFromValue(PickleLoads(resp.Find("ref")->AsBytes()));
 }
 

@@ -56,6 +56,7 @@ enum Op : uint8_t {
   OP_CONTAINS = 6,
   OP_STATS = 7,
   OP_LIST = 8,
+  OP_GET_COPY = 9,  // small-object fast path: data inline, no refcount
 };
 
 enum Status : uint8_t {
@@ -185,6 +186,23 @@ class Store {
     o.lru_tick = ++tick_;
     *size = o.size;
     return ST_OK;
+  }
+
+  // Copy a SEALED+resident object's bytes into `dst` (caller already
+  // validated via get()). Returns false if the object vanished meanwhile.
+  bool read_into(const ObjectId& id, uint8_t* dst, uint64_t size) {
+    auto it = objects_.find(id);
+    if (it == objects_.end() || it->second.state != SEALED ||
+        it->second.size != size)
+      return false;
+    int sfd = shm_open(it->second.shm_name.c_str(), O_RDONLY, 0);
+    if (sfd < 0) return false;
+    void* p = mmap(nullptr, size, PROT_READ, MAP_SHARED, sfd, 0);
+    close(sfd);
+    if (p == MAP_FAILED) return false;
+    memcpy(dst, p, size);
+    munmap(p, size);
+    return true;
   }
 
   Status del(const ObjectId& id) {
@@ -524,6 +542,29 @@ class Server {
           return;  // deferred reply
         }
         return reply(fd, st);
+      }
+      case OP_GET_COPY: {
+        // [op][id][max_inline:8] -> ST_OK + size + payload for SEALED
+        // objects up to max_inline bytes. ONE round trip, no per-client
+        // refcount (the copy is consistent regardless of later eviction)
+        // and no client-side open/mmap — the winning trade for the many-
+        // small-results pattern (get() of task returns). Large or
+        // not-yet-sealed objects return their status; the caller falls
+        // back to the zero-copy OP_GET path.
+        uint64_t max_inline = 0;
+        if (len >= 25) memcpy(&max_inline, p + 17, 8);
+        uint64_t size;
+        Status st = store_->get(id, &size);
+        if (st != ST_OK) return reply(fd, st);
+        if (size > max_inline) return reply(fd, ST_ERR, &size, 8);
+        std::vector<uint8_t> data(8 + size);
+        memcpy(data.data(), &size, 8);
+        if (size) {
+          if (!store_->read_into(id, data.data() + 8, size))
+            return reply(fd, ST_NOT_FOUND);
+        }
+        return reply(fd, ST_OK, data.data(),
+                     static_cast<uint32_t>(data.size()));
       }
       case OP_RELEASE: {
         auto& refs = conns_[fd].refs;

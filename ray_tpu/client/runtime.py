@@ -126,7 +126,9 @@ class ClientRuntime:
 
     # -- objects -----------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
-        return self._dec(self._call("cp_put", blob=self._enc(value))["ref"])
+        import os as _os
+        return self._dec(self._call("cp_put", blob=self._enc(value),
+                                    put_id=_os.urandom(8).hex())["ref"])
 
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:

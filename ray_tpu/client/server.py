@@ -54,6 +54,10 @@ class _Session:
         self.meta = meta
         self.refs: Dict[bytes, ObjectRef] = {}
         self.actors: Dict[bytes, ActorHandle] = {}
+        # put_id -> encoded ref: makes cp_put idempotent under the RPC
+        # layer's at-least-once delivery (a retried put must not mint a
+        # second object). Bounded FIFO.
+        self.put_seen: Dict[str, bytes] = {}
         self.lock = threading.Lock()
 
 
@@ -156,11 +160,23 @@ class ClientProxy:
             return self._fail(e)
 
     # -- objects -----------------------------------------------------------
-    def rpc_cp_put(self, session: str, blob: bytes) -> dict:
+    def rpc_cp_put(self, session: str, blob: bytes,
+                   put_id: Optional[str] = None) -> dict:
         try:
             s = self._session(session)
+            if put_id is not None:
+                with s.lock:
+                    enc = s.put_seen.get(put_id)
+                if enc is not None:
+                    return {"ok": True, "ref": enc}
             ref = self._rt.put(self._dec(s, blob))
-            return {"ok": True, "ref": self._enc(s, ref)}
+            enc = self._enc(s, ref)
+            if put_id is not None:
+                with s.lock:
+                    s.put_seen[put_id] = enc
+                    while len(s.put_seen) > 1024:
+                        s.put_seen.pop(next(iter(s.put_seen)))
+            return {"ok": True, "ref": enc}
         except BaseException as e:  # noqa: BLE001
             return self._fail(e)
 

@@ -22,7 +22,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_STATS, \
-    OP_LIST = range(1, 9)
+    OP_LIST, OP_GET_COPY = range(1, 10)
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_OOM, ST_TIMEOUT, ST_ERR, ST_NOT_SEALED = \
     range(7)
 
@@ -150,6 +150,20 @@ class ShmClient:
             os.close(fd)
         self._maps[oid] = (mm, size)
         return memoryview(mm)
+
+    def get_inline(self, oid: bytes,
+                   max_bytes: int = 64 << 10) -> Optional[bytes]:
+        """Small-object fast path (OP_GET_COPY): the sealed payload comes
+        back INLINE in one round trip — no refcount, no mmap, no release.
+        Returns None when the object is missing, unsealed, or larger than
+        max_bytes (callers fall back to the zero-copy get/release path).
+        """
+        resp = self._call(struct.pack("<B16sQ", OP_GET_COPY, oid, max_bytes))
+        st = resp[0]
+        if st != ST_OK:
+            return None
+        (size,) = struct.unpack("<Q", resp[1:9])
+        return resp[9:9 + size]
 
     def release(self, oid: bytes) -> None:
         mm = self._maps.pop(oid, None)

@@ -116,6 +116,12 @@ class ObjectPlane:
             return False
 
     def get_value(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        # Small sealed LOCAL objects come back inline in ONE store round
+        # trip (no get+release pair, no mmap) — the dominant pattern when
+        # ray_tpu.get() collects many small task results.
+        data = self.store.get_inline(self._key(oid))
+        if data is not None:
+            return serialization.deserialize(memoryview(data))
         view = self.get_view(oid, timeout=timeout)
         value = serialization.deserialize(view)
         # NOTE: buffer-backed values (numpy arrays) stay zero-copy views over

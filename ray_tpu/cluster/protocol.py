@@ -26,16 +26,26 @@ class RpcError(Exception):
     pass
 
 
+_delay_cache: tuple = (-1, None)  # (config generation, cached spec)
+
+
 def _maybe_inject_delay(method: str) -> None:
     """Deterministic chaos-testing delay (parity: the reference's
     RAY_testing_asio_delay_us flag, ray_config_def.h:762, used by
     test_chaos.py to stretch 2PC windows). Set config
     ``testing_rpc_delay_us`` to "<us>" for all methods or
     "<method>:<us>[,<method>:<us>...]" to target specific RPCs."""
+    global _delay_cache
     import time as _time
 
     from ray_tpu import config as _config
-    spec = _config.get("testing_rpc_delay_us")
+    gen, spec = _delay_cache
+    if gen != _config.generation:
+        # This runs on EVERY rpc; re-resolving through os.environ each
+        # time measurably drags task throughput. set_system_config bumps
+        # the generation, so chaos tests still flip it mid-run.
+        spec = _config.get("testing_rpc_delay_us")
+        _delay_cache = (_config.generation, spec)
     if not spec:
         return
     spec = str(spec)
@@ -200,9 +210,11 @@ class RpcClient:
         deadline = (time.monotonic() + self._reconnect_s
                     if self._reconnect_s > 0 else None)
         fresh_retry_done = False
+        force_fresh = False
         while True:
             try:
-                return self._call_once(method, _timeout, kwargs)
+                return self._call_once(method, _timeout, kwargs,
+                                       force_fresh=force_fresh)
             except _PooledSocketDead as e:
                 # A POOLED socket died under us. Ports get reused: the
                 # process-wide client cache (get_client) can hold sockets
@@ -220,7 +232,11 @@ class RpcClient:
                     except OSError:
                         pass
                 if not fresh_retry_done:
+                    # Retry on a GUARANTEED fresh connection: a concurrent
+                    # thread may repool another stale socket between our
+                    # drain and the retry's pool pop.
                     fresh_retry_done = True
+                    force_fresh = True
                     continue
                 if deadline is None or time.monotonic() >= deadline or \
                         self._closed:
@@ -234,9 +250,11 @@ class RpcClient:
                 time.sleep(0.1)
 
     def _call_once(self, method: str, _timeout: Optional[float],
-                   kwargs: dict) -> Any:
-        with self._lock:
-            sock = self._free.pop() if self._free else None
+                   kwargs: dict, force_fresh: bool = False) -> Any:
+        sock = None
+        if not force_fresh:
+            with self._lock:
+                sock = self._free.pop() if self._free else None
         pooled = sock is not None
         if sock is None:
             sock = self._connect()

@@ -95,6 +95,11 @@ class TaskSubmitter:
         self.rt = rt
         self._keys: Dict[tuple, _KeyState] = {}
         self._lock = threading.Lock()
+        # Hot-path flags cached against config.generation (config.get
+        # walks os.environ; at thousands of tasks/s those lookups showed
+        # up in profiles — but overrides must still take effect).
+        self._flags_gen = None
+        self._refresh_flags()
         self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="submit")
         # Lease acquisition runs on its own small pool: acquires can block
@@ -149,7 +154,17 @@ class TaskSubmitter:
                 st = self._keys[key] = _KeyState()
             return st
 
+    def _refresh_flags(self) -> None:
+        if self._flags_gen != config.generation:
+            self._lineage_budget = config.get("max_lineage_bytes")
+            self._pending_lease_cap = config.get(
+                "max_pending_lease_requests")
+            self._default_max_retries = config.get(
+                "task_max_retries_default")
+            self._flags_gen = config.generation
+
     def submit(self, task: dict) -> None:
+        self._refresh_flags()   # one int compare unless overrides changed
         rec = _TaskRecord(task, task["max_retries"])
         with self._lineage_lock:
             for i in range(task["num_returns"]):
@@ -179,7 +194,7 @@ class TaskSubmitter:
         ray_config_def.h). Caller holds _lineage_lock. Only records that are
         BOTH completed and no longer locally referenced are evictable — a
         record for a live ref must survive or its object is unrecoverable."""
-        budget = config.get("max_lineage_bytes")
+        budget = self._lineage_budget
         if self._lineage_bytes <= budget and len(self._lineage) <= 100_000:
             return
         from ray_tpu.core import refs as _refs_mod
@@ -284,7 +299,7 @@ class TaskSubmitter:
                 else:
                     need = len(st.queue)
                     have = st.busy + len(st.idle) + st.pending_leases
-                    pending_cap = config.get("max_pending_lease_requests")
+                    pending_cap = self._pending_lease_cap
                     if st.pending_leases < pending_cap and \
                             have < min(need + st.busy, _MAX_LEASES_PER_KEY):
                         st.pending_leases += 1
@@ -1033,7 +1048,7 @@ class ClusterRuntime:
         # None -> config default; -1 -> retry forever (reference semantics)
         max_retries = opts.max_retries
         if max_retries is None:
-            max_retries = config.get("task_max_retries_default")
+            max_retries = self.submitter._default_max_retries
         task = {
             "task_id": task_id.binary(),
             "function_id": desc.function_id,

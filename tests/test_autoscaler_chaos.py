@@ -90,14 +90,14 @@ def test_rpc_delay_injection(cluster):
     t0 = time.perf_counter()
     cli.call("ping")
     base = time.perf_counter() - t0
-    config._overrides["testing_rpc_delay_us"] = "ping:200000"
+    config.set_override("testing_rpc_delay_us", "ping:200000")
     try:
         t0 = time.perf_counter()
         cli.call("ping")
         delayed = time.perf_counter() - t0
         assert delayed > base + 0.15  # the 200ms injected delay is visible
     finally:
-        config._overrides.pop("testing_rpc_delay_us", None)
+        config.clear_override("testing_rpc_delay_us")
 
 
 def test_chaos_worker_killing_with_retries(cluster):

@@ -5,11 +5,7 @@ verified against a slow numpy reference (the repo's kernel-verification
 pattern)."""
 
 import numpy as np
-import pytest
 
-from ray_tpu.cluster.cluster_utils import Cluster
-from ray_tpu.core import api as core_api
-from ray_tpu.core.runtime_cluster import ClusterRuntime
 
 
 def test_vtrace_matches_reference():

@@ -8,9 +8,6 @@ own tuned-example env) and rllib/models (vision + recurrent nets).
 import numpy as np
 import pytest
 
-from ray_tpu.cluster.cluster_utils import Cluster
-from ray_tpu.core import api as core_api
-from ray_tpu.core.runtime_cluster import ClusterRuntime
 from ray_tpu.rl import sample_batch as sb
 from ray_tpu.rl.sample_batch import SampleBatch
 

@@ -7,9 +7,6 @@ config.py _TorchBackend) and data iter_torch_batches."""
 import numpy as np
 import pytest
 
-from ray_tpu.cluster.cluster_utils import Cluster
-from ray_tpu.core import api as core_api
-from ray_tpu.core.runtime_cluster import ClusterRuntime
 
 
 def test_torch_trainer_ddp(cluster8):
