@@ -96,6 +96,41 @@ def bench_lm(seq: int = 2048, batch_per_chip: int = 8) -> dict:
     }
 
 
+def bench_decode() -> dict:
+    """KV-cache autoregressive decode throughput (models/generate.py):
+    tokens/sec/chip at batch 8 — the serving-side half of the LM story
+    (the training numbers above are the other half)."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import TransformerConfig, generate, transformer_init
+
+    cfg = TransformerConfig(
+        vocab_size=32768, d_model=2048, n_layers=10, n_heads=16,
+        n_kv_heads=16, max_seq=2048, attn_impl="auto",
+        tied_embeddings=True, remat=False)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    batch, prompt_len, new = 8, 128, 256
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                      (batch, prompt_len)), jnp.int32)
+    gen = jax.jit(partial(generate, cfg=cfg, max_new_tokens=new,
+                          temperature=0.0))
+    jax.device_get(gen(params, prompt))          # compile
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        jax.device_get(gen(params, prompt))
+        best = min(best, time.perf_counter() - t0)
+    # Single-device program (unsharded decode): the per-chip figure IS the
+    # one device's throughput — no device_count scaling.
+    return {"decode_tokens_per_sec_per_chip":
+            round(batch * new / best, 1)}
+
+
 def bench_resnet() -> dict:
     import jax
     import jax.numpy as jnp
@@ -150,6 +185,10 @@ def main() -> int:
         lm8k = {"tokens_per_sec_per_chip": 0.0, "mfu": 0.0,
                 "error": repr(e)}
     rn = bench_resnet()
+    try:
+        dec = bench_decode()
+    except Exception as e:  # noqa: BLE001 - additive metric, never fatal
+        dec = {"decode_tokens_per_sec_per_chip": 0.0, "error": repr(e)}
     mfu_gate_pass = lm["mfu"] >= MFU_GATE
     print(json.dumps({
         "metric": "lm_train_tokens_per_sec_per_chip",
@@ -163,6 +202,8 @@ def main() -> int:
         "mfu_gate_pass": mfu_gate_pass,
         "s8192_tokens_per_sec_per_chip": lm8k["tokens_per_sec_per_chip"],
         "s8192_mfu": lm8k["mfu"],
+        "decode_tokens_per_sec_per_chip":
+            dec["decode_tokens_per_sec_per_chip"],
         "resnet50_images_per_sec_per_chip":
             rn["resnet50_images_per_sec_per_chip"],
         "resnet_vs_a100_ddp": round(

@@ -17,6 +17,8 @@ from ray_tpu.models.transformer import (
     transformer_loss,
     transformer_logical_axes,
 )
+from ray_tpu.models.generate import (decode_step, generate, init_cache,
+                                     prefill)
 from ray_tpu.models.resnet import resnet50_init, resnet50_apply, resnet_loss
 from ray_tpu.models.mlp import mlp_init, mlp_apply
 from ray_tpu.models.vit import ViTConfig, vit_init, vit_apply, vit_loss
@@ -24,6 +26,7 @@ from ray_tpu.models.vit import ViTConfig, vit_init, vit_apply, vit_loss
 __all__ = [
     "TransformerConfig", "transformer_init", "transformer_apply",
     "transformer_loss", "transformer_logical_axes",
+    "generate", "prefill", "decode_step", "init_cache",
     "resnet50_init", "resnet50_apply", "resnet_loss",
     "mlp_init", "mlp_apply",
     "ViTConfig", "vit_init", "vit_apply", "vit_loss",

@@ -1,0 +1,176 @@
+"""Autoregressive generation with a KV cache for the flagship Transformer.
+
+The reference has no in-tree LM inference; serving there means wrapping an
+external model in Ray Serve. Here decode is a first-class TPU program
+(completing the LM story: train with jax_step, serve with serve/ + this):
+
+- The KV cache is ONE stacked array pair [L, B, T_max, KVH, D] matching the
+  layer-stacked parameter layout, so decode scans layers exactly like the
+  forward pass (one compiled layer body).
+- `generate` runs the whole decode loop INSIDE jit via lax.scan: static
+  shapes (cache padded to max length, attention masked by position), PRNG
+  threaded through the scan — zero host round-trips per token.
+- Prefill reuses the training forward structure, collecting per-layer K/V
+  as scan outputs; decode steps attend over the cache with a position mask
+  (S=1 queries are bandwidth-bound; masking the padded tail costs nothing
+  against reading the cache itself).
+
+GQA (n_kv_heads < n_heads) is supported; pp_stages>1 is not (decode
+pipelining is a different schedule than GPipe microbatching).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.transformer import (TransformerConfig, _layer_apply,
+                                        _rmsnorm, _rope)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    """[L, B, T, KVH, D] zeros pair (kv dtype = compute dtype)."""
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _project_kv(cfg: TransformerConfig, layer, h, positions):
+    a = layer["attn"]
+    dt = cfg.dtype
+    k = jnp.einsum("bse,ehd->bshd", h, a["wk"].astype(dt))
+    v = jnp.einsum("bse,ehd->bshd", h, a["wv"].astype(dt))
+    return _rope(k, positions, cfg.rope_theta), v
+
+
+def _cached_attention(cfg: TransformerConfig, q, k_cache, v_cache, pos):
+    """q [B, 1, H, D] against cache [B, T, KVH, D], positions <= pos."""
+    b, _, h, d = q.shape
+    t = k_cache.shape[1]
+    kvh = k_cache.shape[2]
+    group = h // kvh
+    qg = q.reshape(b, 1, kvh, group, d)
+    scores = jnp.einsum("bokgd,btkd->bkgt", qg, k_cache) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    mask = (jnp.arange(t) <= pos)[None, None, None, :]
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bkgt,btkd->bkgd", w, v_cache)
+    return o.reshape(b, 1, h, d)
+
+
+def _decode_layer(cfg: TransformerConfig, layer, cache_l, x, pos):
+    """One layer, one token: x [B, 1, E]; cache_l k/v [B, T, KVH, D]."""
+    dt = cfg.dtype
+    h = _rmsnorm(x, layer["ln1"])
+    a = layer["attn"]
+    positions = jnp.full((x.shape[0], 1), pos)
+    q = jnp.einsum("bse,ehd->bshd", h, a["wq"].astype(dt))
+    q = _rope(q, positions, cfg.rope_theta)
+    k_new, v_new = _project_kv(cfg, layer, h, positions)
+    k_cache = lax.dynamic_update_slice(cache_l["k"], k_new, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(cache_l["v"], v_new, (0, pos, 0, 0))
+    o = _cached_attention(cfg, q, k_cache, v_cache, pos)
+    o = jnp.einsum("bshd,hde->bse", o, a["wo"].astype(dt))
+    x = x + o
+    h = _rmsnorm(x, layer["ln2"])
+    if cfg.num_experts:
+        from ray_tpu.models.moe import moe_apply
+        y = moe_apply(cfg, layer["moe"], h)
+    else:
+        m = layer["mlp"]
+        gate = jax.nn.silu(h @ m["w1"].astype(dt))
+        up = h @ m["w3"].astype(dt)
+        y = (gate * up) @ m["w2"].astype(dt)
+    return x + y, {"k": k_cache, "v": v_cache}
+
+
+def prefill(params, tokens, cfg: TransformerConfig, max_len: int,
+            mesh=None) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Run the prompt through the trunk, returning (last-position logits
+    [B, vocab], filled cache). tokens [B, S], S <= max_len."""
+    if cfg.pp_stages > 1:
+        raise NotImplementedError("decode with pp_stages>1 is not supported")
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    body = partial(_layer_apply, cfg, mesh)
+
+    def step(carry, layer):
+        # Recompute this layer's K/V exactly as _layer_apply does so the
+        # cache matches the training forward bit-for-bit.
+        h = _rmsnorm(carry, layer["ln1"])
+        k, v = _project_kv(cfg, layer, h, positions)
+        out = body(layer, carry, positions)
+        pad = max_len - s
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return out, {"k": k, "v": v}
+
+    x, cache = lax.scan(step, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"])
+    head = (params["embed"].T if cfg.tied_embeddings else params["lm_head"])
+    logits = (x[:, -1:] @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return logits[:, 0], cache
+
+
+def decode_step(params, token, pos, cache, cfg: TransformerConfig):
+    """One token for the whole batch: token [B] int32, pos scalar int32.
+    -> (logits [B, vocab], updated cache)."""
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]   # [B, 1, E]
+
+    def step(carry, layer_and_cache):
+        layer, cache_l = layer_and_cache
+        out, new_cache = _decode_layer(cfg, layer, cache_l, carry, pos)
+        return out, new_cache
+
+    x, cache = lax.scan(step, x, (params["layers"], cache))
+    x = _rmsnorm(x, params["final_norm"])
+    head = (params["embed"].T if cfg.tied_embeddings else params["lm_head"])
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return logits[:, 0], cache
+
+
+def _sample(logits, key, temperature: float, top_k: Optional[int]):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        thresh = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < thresh, -1e30, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def generate(params, prompt, cfg: TransformerConfig, *,
+             max_new_tokens: int, temperature: float = 0.0,
+             top_k: Optional[int] = None, seed: int = 0,
+             mesh=None) -> jnp.ndarray:
+    """prompt [B, S] int32 -> generated tokens [B, max_new_tokens].
+
+    The whole decode loop is ONE lax.scan inside the caller's jit scope
+    (wrap with jax.jit(partial(generate, ...)) or call under jit): no
+    per-token host round trips.
+    """
+    b, s = prompt.shape
+    max_len = s + max_new_tokens
+    logits, cache = prefill(params, prompt, cfg, max_len, mesh=mesh)
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    first = _sample(logits, sub, temperature, top_k)
+
+    def step(carry, _):
+        token, pos, cache, key = carry
+        logits, cache = decode_step(params, token, pos, cache, cfg)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sub, temperature, top_k)
+        return (nxt, pos + 1, cache, key), token
+
+    (_, _, _, _), tokens = lax.scan(
+        step, (first, jnp.asarray(s, jnp.int32), cache, key),
+        None, length=max_new_tokens)
+    return jnp.transpose(tokens, (1, 0))   # [B, max_new_tokens]
