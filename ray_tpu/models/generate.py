@@ -21,15 +21,25 @@ pipelining is a different schedule than GPipe microbatching).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+import dataclasses
+
 from ray_tpu.models.transformer import (TransformerConfig, _layer_apply,
                                         _rmsnorm, _rope)
+
+
+def _inference_cfg(cfg: TransformerConfig) -> TransformerConfig:
+    """Dropless MoE at inference: capacity dropping is a training
+    throughput trade; S=1 decode never drops, so prefill must not either
+    or cached and uncached passes diverge."""
+    if cfg.num_experts and cfg.moe_capacity_factor is None:
+        return dataclasses.replace(cfg, moe_capacity_factor=1e9)
+    return cfg
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
@@ -95,18 +105,16 @@ def prefill(params, tokens, cfg: TransformerConfig, max_len: int,
     [B, vocab], filled cache). tokens [B, S], S <= max_len."""
     if cfg.pp_stages > 1:
         raise NotImplementedError("decode with pp_stages>1 is not supported")
+    cfg = _inference_cfg(cfg)
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     x = params["embed"].astype(cfg.dtype)[tokens]
 
-    body = partial(_layer_apply, cfg, mesh)
-
     def step(carry, layer):
-        # Recompute this layer's K/V exactly as _layer_apply does so the
-        # cache matches the training forward bit-for-bit.
-        h = _rmsnorm(carry, layer["ln1"])
-        k, v = _project_kv(cfg, layer, h, positions)
-        out = body(layer, carry, positions)
+        # return_kv hands back the layer's already-computed rotated K/V —
+        # cache matches the forward bit-for-bit at zero extra FLOPs.
+        out, (k, v) = _layer_apply(cfg, mesh, layer, carry, positions,
+                                   return_kv=True)
         pad = max_len - s
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
@@ -122,6 +130,7 @@ def prefill(params, tokens, cfg: TransformerConfig, max_len: int,
 def decode_step(params, token, pos, cache, cfg: TransformerConfig):
     """One token for the whole batch: token [B] int32, pos scalar int32.
     -> (logits [B, vocab], updated cache)."""
+    cfg = _inference_cfg(cfg)
     x = params["embed"].astype(cfg.dtype)[token][:, None, :]   # [B, 1, E]
 
     def step(carry, layer_and_cache):
@@ -156,6 +165,7 @@ def generate(params, prompt, cfg: TransformerConfig, *,
     (wrap with jax.jit(partial(generate, ...)) or call under jit): no
     per-token host round trips.
     """
+    cfg = _inference_cfg(cfg)
     b, s = prompt.shape
     max_len = s + max_new_tokens
     logits, cache = prefill(params, prompt, cfg, max_len, mesh=mesh)

@@ -15,13 +15,19 @@ import jax
 import jax.numpy as jnp
 
 
-def moe_apply(cfg, moe_params, h, *, capacity_factor: float = 1.25):
-    """h: [B, S, D] -> [B, S, D]. Top-k capacity routing per batch row."""
+def moe_apply(cfg, moe_params, h, *, capacity_factor=None):
+    """h: [B, S, D] -> [B, S, D]. Top-k capacity routing per batch row.
+
+    capacity resolution: explicit arg > cfg.moe_capacity_factor > 1.25
+    (training default). Inference passes a huge factor (dropless) so
+    cached decode matches the full forward (models/generate.py)."""
     dt = h.dtype
     b, s, d = h.shape
     e = cfg.num_experts
     k = cfg.expert_top_k
-    cap = max(1, int(capacity_factor * s * k / e))
+    if capacity_factor is None:
+        capacity_factor = getattr(cfg, "moe_capacity_factor", None) or 1.25
+    cap = min(s * k, max(1, int(capacity_factor * s * k / e)))
 
     logits = jnp.einsum("bsd,de->bse", h, moe_params["router"].astype(dt))
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)

@@ -49,6 +49,11 @@ class TransformerConfig:
     num_microbatches: int = 1             # pipeline microbatches
     # MoE (0 = dense)
     num_experts: int = 0
+    # None -> moe_apply's training default (1.25). Inference sets a huge
+    # factor (dropless): capacity dropping is a TRAINING throughput trade;
+    # at decode S=1 every token always fits, so prefill must match or
+    # cached and uncached forward passes diverge (models/generate.py).
+    moe_capacity_factor: Optional[float] = None
     expert_top_k: int = 1
     tied_embeddings: bool = False
 
@@ -198,7 +203,8 @@ def _attention(cfg: TransformerConfig, q, k, v, mesh):
     return mha(q, k, v, causal=cfg.causal, impl=impl)
 
 
-def _layer_apply(cfg: TransformerConfig, mesh, layer, x, positions):
+def _layer_apply(cfg: TransformerConfig, mesh, layer, x, positions,
+                 return_kv: bool = False):
     dt = cfg.dtype
     h = _rmsnorm(x, layer["ln1"])
     a = layer["attn"]
@@ -219,6 +225,10 @@ def _layer_apply(cfg: TransformerConfig, mesh, layer, x, positions):
         gate = jax.nn.silu(h @ m["w1"].astype(dt))
         up = h @ m["w3"].astype(dt)
         y = (gate * up) @ m["w2"].astype(dt)
+    if return_kv:
+        # KV-cache prefill path (models/generate.py): hand back the
+        # ALREADY-COMPUTED rotated K and V instead of recomputing them.
+        return x + y, (k, v)
     return x + y
 
 

@@ -121,7 +121,8 @@ class Deployment:
                  ray_actor_options: Optional[dict] = None,
                  user_config=None, route_prefix: Optional[str] = None,
                  max_concurrent_queries: int = 100,
-                 autoscaling_config: Optional[dict] = None):
+                 autoscaling_config: Optional[dict] = None,
+                 init_grace_s: float = 120.0):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
@@ -131,13 +132,17 @@ class Deployment:
             else f"/{name}"
         self.max_concurrent_queries = max_concurrent_queries
         self.autoscaling_config = autoscaling_config
+        # How long a spawned replica may stay silent while __init__ runs
+        # (model loads) before an unanswered health ping means death.
+        self.init_grace_s = init_grace_s
         self._init_args = ((), {})
 
     def options(self, **updates) -> "Deployment":
         d = Deployment(self._target, updates.pop("name", self.name),
                        self.num_replicas, dict(self.ray_actor_options),
                        self.user_config, self.route_prefix,
-                       self.max_concurrent_queries, self.autoscaling_config)
+                       self.max_concurrent_queries, self.autoscaling_config,
+                       self.init_grace_s)
         for k, v in updates.items():
             setattr(d, k, v)
         d._init_args = self._init_args
@@ -161,7 +166,7 @@ class Deployment:
             cloudpickle.dumps((init_args, init_kwargs)),
             self.num_replicas, self.ray_actor_options, self.user_config,
             self.route_prefix, self.max_concurrent_queries,
-            self.autoscaling_config), timeout=300)
+            self.autoscaling_config, self.init_grace_s), timeout=300)
         return DeploymentHandle(self.name)
 
 

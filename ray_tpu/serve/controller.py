@@ -75,6 +75,10 @@ class ServeController:
     def __init__(self, http_port: int = 0):
         self.deployments: Dict[str, dict] = {}   # name -> spec
         self.replicas: Dict[str, List[Any]] = {}  # name -> actor handles
+        # Replica lifecycle for the init-grace window: actor_id -> spawn
+        # time; ids that have answered >=1 health ping.
+        self._replica_started: Dict[Any, float] = {}
+        self._replica_ready: set = set()
         self._lock = threading.Lock()
         # serializes reconcile passes (deploy() and the loop both enter;
         # the controller actor itself runs with max_concurrency > 1)
@@ -91,7 +95,8 @@ class ServeController:
                num_replicas: int, ray_actor_options: dict,
                user_config=None, route_prefix: Optional[str] = None,
                max_concurrent_queries: int = 100,
-               autoscaling: Optional[dict] = None) -> bool:
+               autoscaling: Optional[dict] = None,
+               init_grace_s: float = 120.0) -> bool:
         with self._lock:
             self.deployments[name] = {
                 "name": name, "cls_blob": cls_blob,
@@ -102,6 +107,7 @@ class ServeController:
                 "route_prefix": route_prefix,
                 "max_concurrent_queries": max_concurrent_queries,
                 "autoscaling": autoscaling,
+                "init_grace_s": init_grace_s,
             }
         self._reconcile_once()
         return True
@@ -118,6 +124,28 @@ class ServeController:
                 pass
         return True
 
+    def _kill_replica(self, handle) -> None:
+        import ray_tpu as rt
+        try:
+            rt.kill(handle)
+        except Exception:
+            pass
+        self._replica_started.pop(handle._rt_actor_id, None)
+        self._replica_ready.discard(handle._rt_actor_id)
+
+    @staticmethod
+    def _actor_dead(handle) -> bool:
+        """Authoritative liveness from the conductor's actor FSM — a
+        replica that is DEAD must be replaced immediately even inside the
+        init-grace window (a stuck ping is ambiguous; DEAD is not)."""
+        try:
+            from ray_tpu.core.api import _global_runtime
+            info = _global_runtime().conductor.call(
+                "get_actor_info", actor_id=handle._rt_actor_id.binary())
+            return (info or {}).get("state") == "DEAD"
+        except Exception:
+            return False
+
     def _spawn_replica(self, spec: dict):
         import ray_tpu as rt
         opts = dict(spec["ray_actor_options"])
@@ -128,6 +156,7 @@ class ServeController:
             resources=opts.get("resources", {}),
             max_concurrency=spec["max_concurrent_queries"],
         ).remote(spec["cls_blob"], spec["init_args_blob"])
+        self._replica_started[handle._rt_actor_id] = time.time()
         if spec.get("user_config") is not None:
             rt.get(handle.reconfigure.remote(spec["user_config"]),
                    timeout=120)
@@ -144,27 +173,47 @@ class ServeController:
             specs = dict(self.deployments)
         for name, spec in specs.items():
             current = self.replicas.setdefault(name, [])
-            # replace dead replicas (health check by ping)
+            # Replace dead replicas (health check by ping). A replica whose
+            # __init__ is still running (model load, framework imports —
+            # routine for ML deployments) answers nothing yet: give it an
+            # initialization GRACE window before a failed ping is treated
+            # as death (parity: serve's replica startup timeout,
+            # RAY_SERVE_REPLICA... init deadline vs health period).
+            grace = float(spec.get("init_grace_s", 120.0))
+            from ray_tpu.core.exceptions import GetTimeoutError
             alive = []
             for a in current:
                 try:
                     rt.get(a.check_health.remote(), timeout=10)
+                    self._replica_ready.add(a._rt_actor_id)
                     alive.append(a)
+                except GetTimeoutError:
+                    # ONLY a silent ping (no answer yet) earns the grace;
+                    # a replica that ANSWERED with an error is unhealthy
+                    # and replaced immediately (the except below).
+                    started = self._replica_started.get(a._rt_actor_id, 0.0)
+                    initializing = (a._rt_actor_id not in
+                                    self._replica_ready and
+                                    time.time() - started < grace and
+                                    not self._actor_dead(a))
+                    if initializing:
+                        alive.append(a)   # still booting — keep waiting
+                        continue
+                    self._kill_replica(a)
                 except Exception:
-                    try:
-                        rt.kill(a)
-                    except Exception:
-                        pass
+                    self._kill_replica(a)
             current[:] = alive
             target = spec["num_replicas"]
             while len(current) < target:
                 current.append(self._spawn_replica(spec))
-            import ray_tpu as rt2
             while len(current) > target:
-                try:
-                    rt2.kill(current.pop())
-                except Exception:
-                    pass
+                self._kill_replica(current.pop())
+        # Lifecycle maps only ever track LIVE handles (scale-downs,
+        # deletes, shutdowns all funnel through here eventually).
+        live = {a._rt_actor_id for rs in self.replicas.values() for a in rs}
+        for aid in [k for k in self._replica_started if k not in live]:
+            self._replica_started.pop(aid, None)
+        self._replica_ready &= live
 
     def _reconcile_loop(self) -> None:
         while not self._stopped:

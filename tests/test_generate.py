@@ -69,9 +69,15 @@ def test_prefill_logits_match_forward():
 
 
 def test_gqa_and_moe_decode():
+    import dataclasses
+
     cfg = _cfg(n_kv_heads=1, num_experts=4, expert_top_k=2)
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 4), 0, 97)
-    want = _naive_greedy(params, prompt, cfg, 6)
+    # Inference is DROPLESS MoE; the uncached reference must match that
+    # semantics (training capacity dropping is a throughput trade, and
+    # would make cached/uncached diverge whenever an expert overflows).
+    infer_cfg = dataclasses.replace(cfg, moe_capacity_factor=1e9)
+    want = _naive_greedy(params, prompt, infer_cfg, 6)
     got = generate(params, prompt, cfg, max_new_tokens=6, temperature=0.0)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

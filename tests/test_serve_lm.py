@@ -1,0 +1,85 @@
+"""End-to-end LM serving: the KV-cache generator behind a serve deployment
+with request batching — the framework's train→serve story closed
+(reference role: serving an LLM through Ray Serve; here the model AND the
+decode loop are in-tree TPU programs)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
+
+
+def test_serve_lm_generate(cluster):
+    @serve.deployment(num_replicas=1, route_prefix="/generate")
+    class LMServer:
+        def __init__(self):
+            from functools import partial
+
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models import (TransformerConfig, generate,
+                                        transformer_init)
+            self.jnp = jnp
+            self.cfg = TransformerConfig(
+                vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, max_seq=96, attn_impl="reference",
+                dtype=jnp.float32)
+            self.params = transformer_init(jax.random.PRNGKey(0), self.cfg)
+            self._gen = jax.jit(partial(
+                generate, cfg=self.cfg, max_new_tokens=8, temperature=0.0))
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def generate_batch(self, prompts):
+            import numpy as np
+            # Batch variable-length prompts by left-padding to a common
+            # length (pad id 0; fine for a smoke model).
+            width = max(len(p) for p in prompts)
+            arr = np.zeros((len(prompts), width), np.int32)
+            for i, p in enumerate(prompts):
+                arr[i, width - len(p):] = p
+            out = np.asarray(self._gen(self.params, self.jnp.asarray(arr)))
+            return [row.tolist() for row in out]
+
+        def __call__(self, prompt=None):
+            return {"tokens": self.generate_batch(prompt)}
+
+    handle = serve.run(LMServer.bind(), http_host="127.0.0.1")
+    # handle path
+    out = rt.get(handle.options(method_name="generate_batch")
+                 .remote([1, 2, 3]), timeout=120)
+    assert len(out) == 8 and all(0 <= t < 256 for t in out)
+    # HTTP path (sync __call__ through the threaded batcher)
+    port = handle.http_port
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"prompt": [5, 6, 7, 8]}).encode(),
+        headers={"Content-Type": "application/json"})
+    body = json.loads(urllib.request.urlopen(req, timeout=120).read())
+    assert len(body["tokens"]) == 8
+    # determinism: same prompt, greedy -> same tokens via both paths
+    out2 = rt.get(handle.options(method_name="generate_batch")
+                  .remote([5, 6, 7, 8]), timeout=120)
+    assert out2 == body["tokens"]
+    serve.delete("LMServer")
