@@ -20,13 +20,15 @@ _local = threading.local()
 class _Session:
     def __init__(self, world_rank: int, world_size: int, local_rank: int,
                  trial_dir: str = "", config: Optional[dict] = None,
-                 checkpoint: Optional[Checkpoint] = None):
+                 checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
         self.trial_dir = trial_dir
         self.config = config or {}
         self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
         self.reports = []           # consumed by the worker actor
         self.report_event = threading.Condition()
         self.iteration = 0
@@ -68,6 +70,19 @@ def report(metrics: Dict[str, Any],
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return _require_session().loaded_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of the Dataset the trainer was given via
+    ``datasets={name: ds}`` (parity: air/session.py get_dataset_shard —
+    the data->train integration point). Iterate it with iter_batches /
+    iter_torch_batches inside the loop."""
+    shards = _require_session().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset {name!r} was passed to the trainer "
+            f"(have: {sorted(shards)})")
+    return shards[name]
 
 
 def get_world_rank() -> int:

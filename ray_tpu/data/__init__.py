@@ -13,8 +13,10 @@ from ray_tpu.data.datasource import (from_arrow, from_items, from_numpy,
                                      read_csv, read_json, read_numpy,
                                      read_parquet)
 from ray_tpu.data import preprocessors
+from ray_tpu.data.llm import ByteTokenizer, tokenize_and_pack
 
 __all__ = ["Dataset", "DatasetPipeline", "GroupedData", "Block",
            "BlockAccessor", "range", "from_items", "from_numpy",
            "from_pandas", "from_arrow", "read_parquet", "read_csv",
-           "read_json", "read_numpy", "read_binary_files", "preprocessors"]
+           "read_json", "read_numpy", "read_binary_files", "preprocessors",
+           "ByteTokenizer", "tokenize_and_pack"]

@@ -96,6 +96,10 @@ def _split_task(block: Block, n_out: int, seed: Optional[int],
     return [acc.take_indices(s) for s in shards]
 
 
+def _slice_block_task(block: Block, start: int, stop: int) -> Block:
+    return BlockAccessor(block).slice(start, stop)
+
+
 def _merge_task(*blocks: Block) -> Block:
     return BlockAccessor.concat(list(blocks))
 
@@ -391,10 +395,40 @@ class Dataset:
         return BlockAccessor.concat(
             [rt.get(r) for r in self.materialize_refs()]).to_pandas()
 
-    def split(self, n: int) -> List["Dataset"]:
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Split into n datasets. ``equal=True`` gives every split EXACTLY
+        ``total_rows // n`` rows (remainder dropped) — required when the
+        splits feed a collective-per-step training gang, where uneven step
+        counts deadlock the tail (parity: Dataset.split(equal=True), the
+        mode get_dataset_shard relies on)."""
         refs = self.materialize_refs()
-        parts = np.array_split(np.arange(len(refs)), n)
-        return [Dataset([refs[i] for i in idx]) for idx in parts]
+        if not equal:
+            parts = np.array_split(np.arange(len(refs)), n)
+            return [Dataset([refs[i] for i in idx]) for idx in parts]
+        import ray_tpu as rt
+        counts = [BlockAccessor(rt.get(r)).num_rows() for r in refs]
+        per = sum(counts) // n
+        out: List[Dataset] = []
+        block_i, offset = 0, 0   # cursor into (refs, row-within-block)
+        for _ in range(n):
+            need = per
+            pieces: List[Any] = []
+            while need > 0 and block_i < len(refs):
+                avail = counts[block_i] - offset
+                take = min(avail, need)
+                if offset == 0 and take == counts[block_i]:
+                    pieces.append(refs[block_i])       # whole block as-is
+                else:
+                    pieces.append(self._submit(
+                        _slice_block_task, refs[block_i], offset,
+                        offset + take))
+                need -= take
+                offset += take
+                if offset >= counts[block_i]:
+                    block_i += 1
+                    offset = 0
+            out.append(Dataset(pieces))
+        return out
 
     def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
         return DatasetPipeline(self, times)

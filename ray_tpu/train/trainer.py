@@ -65,12 +65,16 @@ class DataParallelTrainer(BaseTrainer):
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None,
-                 backend: Optional[Backend] = None):
+                 backend: Optional[Backend] = None,
+                 datasets: Optional[dict] = None):
         super().__init__(scaling_config=scaling_config, run_config=run_config,
                          resume_from_checkpoint=resume_from_checkpoint)
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config or {}
         self.backend = backend or self._backend_cls()
+        # name -> ray_tpu.data.Dataset, split per-rank at fit() and exposed
+        # in workers via session.get_dataset_shard (air session parity).
+        self.datasets = datasets or {}
 
     def fit(self) -> Result:
         cfg = self.run_config
@@ -110,7 +114,8 @@ class DataParallelTrainer(BaseTrainer):
                 executor.run(self.train_loop_per_worker,
                              self.train_loop_config, on_report,
                              trial_dir=trial_dir,
-                             checkpoint=state["last_checkpoint"])
+                             checkpoint=state["last_checkpoint"],
+                             datasets=self.datasets)
                 return Result(metrics=state["last_metrics"],
                               checkpoint=state["last_checkpoint"],
                               metrics_history=state["history"],
