@@ -1,0 +1,49 @@
+"""Core API tour: tasks, objects, actors (run: JAX_PLATFORMS=cpu python
+examples/01_core_api.py)."""
+import ray_tpu as rt
+
+rt.init(num_cpus=8)  # explicit size: actors HOLD their CPU, so
+# leave headroom for tasks scheduled alongside them
+
+
+@rt.remote
+def square(x):
+    return x * x
+
+
+@rt.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k=1):
+        self.n += k
+        return self.n
+
+
+# tasks fan out; refs compose (square-of-square without fetching)
+refs = [square.remote(i) for i in range(8)]
+print("squares:", rt.get(refs))
+print("chained:", rt.get(square.remote(refs[3])))
+
+# objects: put once, share by reference with tasks
+big = rt.put(list(range(10_000)))
+
+
+@rt.remote
+def total(xs):
+    return sum(xs)
+
+
+print("sum(big):", rt.get(total.remote(big)))   # the REF travels, not data
+print("fractional cpu:", rt.get(square.options(num_cpus=0.5).remote(3)))
+
+# actors: stateful, ordered
+c = Counter.remote()
+for _ in range(5):
+    c.add.remote()
+print("count:", rt.get(c.add.remote(0)))
+
+ready, pending = rt.wait([square.remote(2), square.remote(3)], num_returns=1)
+print("first ready:", rt.get(ready[0]))
+rt.shutdown()

@@ -1,0 +1,18 @@
+"""PPO on CartPole via the Algorithm API (run: JAX_PLATFORMS=cpu python
+examples/05_rl_cartpole.py)."""
+import ray_tpu as rt
+from ray_tpu.rl.algorithms import PPOConfig
+
+rt.init(num_cpus=8)  # explicit size: actors HOLD their CPU, so
+# leave headroom for tasks scheduled alongside them
+config = (PPOConfig().environment("CartPole-v1")
+          .rollouts(num_rollout_workers=2, num_envs_per_worker=8))
+algo = config.build()
+for i in range(5):
+    result = algo.train()
+    print(f"iter {i}: reward={result['episode_reward_mean']:.1f} "
+          f"steps={result['timesteps_total']}")
+ckpt = algo.save()
+print("checkpoint:", ckpt)
+algo.stop()
+rt.shutdown()

@@ -1,163 +1,14 @@
-"""Scheduler/object-plane microbenchmarks (reference: `ray microbenchmark`,
-python/ray/_private/ray_perf.py:93-240 — same op families, re-measured for
-this runtime).
+"""Driver entry shim — the single microbenchmark suite lives in
+ray_tpu/cluster/microbench.py (one harness; the CLI
+`python -m ray_tpu microbenchmark` runs the same code)."""
 
-Runs against a real local cluster (conductor + node daemon + shm store +
-spawned workers — NOT local_mode) so the numbers include the full RPC,
-lease, serialization and shm paths. Writes MICROBENCH_r{N}.json when
---round N is given, else prints to stdout.
-
-Usage:
-    JAX_PLATFORMS=cpu python microbench.py [--round 2] [--quick]
-"""
-
-from __future__ import annotations
-
-import argparse
-import json
 import os
 import sys
-import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-
-def timed(fn, *, min_time: float = 1.0, min_iters: int = 3):
-    """Run fn() repeatedly until min_time elapsed; return (per_call_s, n)."""
-    fn()  # warmup
-    n, t0 = 0, time.perf_counter()
-    while True:
-        fn()
-        n += 1
-        dt = time.perf_counter() - t0
-        if dt >= min_time and n >= min_iters:
-            return dt / n, n
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--round", type=int, default=0)
-    ap.add_argument("--quick", action="store_true")
-    args = ap.parse_args()
-
-    import numpy as np
-
-    import ray_tpu
-    from ray_tpu.cluster.cluster_utils import Cluster
-
-    scale = 0.2 if args.quick else 1.0
-    results: dict = {}
-
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
-    ray_tpu.init(address=c.address)
-    try:
-        # -- put/get small objects ------------------------------------
-        def put_small():
-            for _ in range(100):
-                ray_tpu.put(b"x" * 1024)
-
-        per, _ = timed(put_small, min_time=1.0 * scale)
-        results["put_1kb_per_sec"] = round(100 / per, 1)
-
-        ref = ray_tpu.put(b"y" * 1024)
-
-        def get_small():
-            for _ in range(100):
-                ray_tpu.get(ref)
-
-        per, _ = timed(get_small, min_time=1.0 * scale)
-        results["get_1kb_per_sec"] = round(100 / per, 1)
-
-        # -- put/get bandwidth (100MB numpy, zero-copy shm path) ------
-        big = np.zeros(100 * 1024 * 1024, dtype=np.uint8)
-
-        def put_big():
-            ray_tpu.get(ray_tpu.put(big))
-
-        per, _ = timed(put_big, min_time=2.0 * scale, min_iters=2)
-        results["put_get_100mb_gb_per_sec"] = round(0.1 / per, 2)
-
-        # -- task submit+get roundtrip --------------------------------
-        @ray_tpu.remote
-        def nop():
-            return None
-
-        def task_roundtrip():
-            ray_tpu.get(nop.remote())
-
-        per, _ = timed(task_roundtrip, min_time=2.0 * scale)
-        results["task_roundtrip_per_sec"] = round(1 / per, 1)
-
-        # -- async task throughput (pipelined submissions) ------------
-        n_tasks = int(1000 * scale) or 100
-
-        def task_async():
-            ray_tpu.get([nop.remote() for _ in range(n_tasks)])
-
-        per, _ = timed(task_async, min_time=2.0 * scale, min_iters=2)
-        results["tasks_async_per_sec"] = round(n_tasks / per, 1)
-
-        # -- actor calls ----------------------------------------------
-        @ray_tpu.remote
-        class Counter:
-            def __init__(self):
-                self.x = 0
-
-            def incr(self):
-                self.x += 1
-                return self.x
-
-        a = Counter.remote()
-        ray_tpu.get(a.incr.remote())
-
-        def actor_sync():
-            ray_tpu.get(a.incr.remote())
-
-        per, _ = timed(actor_sync, min_time=2.0 * scale)
-        results["actor_call_sync_per_sec"] = round(1 / per, 1)
-
-        n_calls = int(1000 * scale) or 100
-
-        def actor_async():
-            ray_tpu.get([a.incr.remote() for _ in range(n_calls)])
-
-        per, _ = timed(actor_async, min_time=2.0 * scale, min_iters=2)
-        results["actor_calls_async_per_sec"] = round(n_calls / per, 1)
-
-        # -- wait over many refs --------------------------------------
-        refs = [ray_tpu.put(i) for i in range(1000)]
-
-        def wait_1k():
-            ray_tpu.wait(refs, num_returns=len(refs), timeout=30)
-
-        per, _ = timed(wait_1k, min_time=1.0 * scale, min_iters=2)
-        results["wait_1k_refs_per_sec"] = round(1 / per, 2)
-
-        # -- scheduler drain: queue 2k tasks at once ------------------
-        n_q = int(2000 * scale) or 200
-        t0 = time.perf_counter()
-        ray_tpu.get([nop.remote() for _ in range(n_q)])
-        results["queued_tasks_drained_per_sec"] = round(
-            n_q / (time.perf_counter() - t0), 1)
-    finally:
-        ray_tpu.shutdown()
-        c.shutdown()
-
-    out = {
-        "suite": "ray_tpu microbenchmark",
-        "reference_analog": "python/ray/_private/ray_perf.py:93",
-        "mode": "cluster (conductor+daemon+shm store+spawned workers)",
-        "results": results,
-    }
-    line = json.dumps(out, indent=2)
-    if args.round:
-        path = f"MICROBENCH_r{args.round:02d}.json"
-        with open(path, "w") as f:
-            f.write(line + "\n")
-        print(f"wrote {path}")
-    print(line)
-    return 0
-
+from ray_tpu.cluster.microbench import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

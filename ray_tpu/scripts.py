@@ -160,7 +160,11 @@ def cmd_metrics(args) -> None:
 
 def cmd_microbenchmark(args) -> None:
     from ray_tpu.cluster.microbench import run_microbenchmark
-    run_microbenchmark(address=getattr(args, "address", None))
+    addr = getattr(args, "address", None)
+    if addr:
+        print("note: microbenchmark ignores --address (it measures a "
+              "fresh local cluster for run-to-run comparability)")
+    run_microbenchmark()
 
 
 def cmd_job(args) -> None:

@@ -1,0 +1,39 @@
+"""The examples/ scripts stay runnable (smoke: the fast ones end-to-end)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(name, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    assert out.returncode == 0, f"{name} failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def test_example_core_api():
+    out = _run("01_core_api.py")
+    assert "squares: [0, 1, 4, 9, 16, 25, 36, 49]" in out
+    assert "chained: 81" in out
+    assert "count: 5" in out
+
+
+def test_example_train_lm_multichip():
+    out = _run("02_train_lm_multichip.py")
+    assert "step 4: loss=" in out
+    assert "sharding" in out
+
+
+def test_example_data_pipeline():
+    out = _run("04_data_pipeline.py")
+    assert "packed sequences:" in out
+    assert "rows" in out
