@@ -19,18 +19,25 @@ from ray_tpu.cluster.protocol import get_client
 class Cluster:
     def __init__(self, initialize_head: bool = True,
                  head_node_args: Optional[dict] = None,
-                 health_timeout_s: float = 3.0):
-        self.conductor = Conductor(health_timeout_s=health_timeout_s)
+                 health_timeout_s: float = 3.0,
+                 host: str = "127.0.0.1"):
+        self.conductor = Conductor(host=host,
+                                   health_timeout_s=health_timeout_s)
         self.address = self.conductor.address
         self.nodes: List[NodeDaemon] = []
         if initialize_head:
-            self.add_node(is_head=True, **(head_node_args or {}))
+            # The auto-created head inherits the CLUSTER host unless the
+            # caller overrides it (a conductor on a LAN IP with its head
+            # node quietly on 127.0.0.1 would be unreachable remotely).
+            self.add_node(is_head=True,
+                          **{"host": host, **(head_node_args or {})})
 
     def add_node(self, num_cpus: float = 4.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
                  object_store_bytes: int = 256 << 20,
                  is_head: bool = False,
-                 tpu_slice: Optional[dict] = None) -> NodeDaemon:
+                 tpu_slice: Optional[dict] = None,
+                 host: str = "127.0.0.1") -> NodeDaemon:
         """``tpu_slice`` injects fake slice membership (slice_id,
         accelerator_type, generation, worker_id, num_hosts) — the test
         analog of a real TPU host's env-derived topology.detect_slice()."""
@@ -38,7 +45,7 @@ class Cluster:
         if num_tpus:
             total["TPU"] = float(num_tpus)
         total.update(resources or {})
-        node = NodeDaemon(self.address, resources=total,
+        node = NodeDaemon(self.address, resources=total, host=host,
                           object_store_bytes=object_store_bytes,
                           is_head=is_head, tpu_slice=tpu_slice)
         self.nodes.append(node)
