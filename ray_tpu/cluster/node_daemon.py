@@ -288,8 +288,21 @@ class NodeDaemon:
         cwd = None
         if runtime_env and runtime_env.get("working_dir"):
             cwd = runtime_env["working_dir"]
+        py_exe = sys.executable
+        if runtime_env and runtime_env.get("pip"):
+            # venv per pip-spec hash (runtime-env agent role); the worker
+            # runs on the venv interpreter so its installs are importable.
+            from ray_tpu.runtime_env import ensure_pip_env
+            py_exe = ensure_pip_env(runtime_env["pip"], self.session_dir)
+            # ray_tpu itself rides PYTHONPATH into the venv interpreter.
+            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            prev = env.get("PYTHONPATH", "")
+            if repo_root not in prev.split(os.pathsep):
+                env["PYTHONPATH"] = (repo_root + os.pathsep + prev) if prev \
+                    else repo_root
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.cluster.worker_main",
+            [py_exe, "-m", "ray_tpu.cluster.worker_main",
              "--conductor", self.conductor_address,
              "--daemon", self.address,
              "--store-socket", self.store_socket,
@@ -319,6 +332,12 @@ class NodeDaemon:
 
     def _checkout_worker(self, env_key: str, runtime_env: Optional[dict],
                          timeout: float = 30.0) -> Optional[_Worker]:
+        if runtime_env and runtime_env.get("pip"):
+            # Materialize the venv BEFORE the spawn deadline starts: first
+            # builds can take longer than the checkout budget, and the
+            # cached hit on the spawn path below is then instant.
+            from ray_tpu.runtime_env import ensure_pip_env
+            ensure_pip_env(runtime_env["pip"], self.session_dir)
         while True:
             with self._lock:
                 q = self._idle.get(env_key)
@@ -554,12 +573,14 @@ class NodeDaemon:
                 except ValueError:
                     pass
         env_key = self._env_key_of(runtime_env)
-        w = self._checkout_worker(env_key, runtime_env, timeout=10.0)
+        from ray_tpu.core.exceptions import RuntimeEnvSetupError
+        try:
+            w = self._checkout_worker(env_key, runtime_env, timeout=10.0)
+        except RuntimeEnvSetupError as e:
+            self._give_back(strategy, resources)
+            return {"granted": False, "env_error": str(e)}
         if w is None:
-            with self._cv:
-                _, _, give = self._resource_pool_for(strategy)
-                give(resources)
-                self._cv.notify_all()
+            self._give_back(strategy, resources)
             return {"granted": False, "infeasible": False}
         lease_id = uuid.uuid4().hex
         with self._lock:
@@ -571,6 +592,13 @@ class NodeDaemon:
         return {"granted": True, "lease_id": lease_id,
                 "worker_address": w.address, "worker_pid": w.pid,
                 "node_id": self.node_id}
+
+    def _give_back(self, strategy: Any,
+                   resources: Dict[str, float]) -> None:
+        with self._cv:
+            _, _, give = self._resource_pool_for(strategy)
+            give(resources)
+            self._cv.notify_all()
 
     def _drop_demand(self, entry: Dict[str, float]) -> None:
         with self._lock:
@@ -640,8 +668,23 @@ class NodeDaemon:
                         pass
                     return
                 self._cv.wait(0.5)
-        w = self._checkout_worker(self._env_key_of(opts.get("runtime_env")),
-                                  opts.get("runtime_env"))
+        from ray_tpu.core.exceptions import RuntimeEnvSetupError
+        try:
+            w = self._checkout_worker(
+                self._env_key_of(opts.get("runtime_env")),
+                opts.get("runtime_env"))
+        except RuntimeEnvSetupError as e:
+            # Deterministic env failure: free the reservation and fail the
+            # actor's creation (callers holding refs see the error instead
+            # of a forever-PENDING actor).
+            self._give_back(strategy, resources)
+            try:
+                cli.call("actor_creation_failed", actor_id=actor_id,
+                         incarnation=incarnation,
+                         error_blob=pickle.dumps(e))
+            except Exception:
+                pass
+            return
         if w is None:
             with self._cv:
                 _, _, give = self._resource_pool_for(strategy)

@@ -52,6 +52,12 @@ class ObjectLostError(RayTpuError):
         super().__init__(f"Object {object_id_hex} lost: {reason}")
 
 
+class RuntimeEnvSetupError(RayTpuError):
+    """Materializing a task/actor's runtime_env failed (bad pip spec,
+    missing wheels, ...). Deterministic — the task fails instead of
+    retrying forever (parity: ray.exceptions.RuntimeEnvSetupError)."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

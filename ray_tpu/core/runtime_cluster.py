@@ -310,9 +310,15 @@ class TaskSubmitter:
             self._pool.submit(self._run_on, st, w, recs)
 
     def _acquire_lease(self, st: _KeyState, task: dict) -> None:
+        from ray_tpu.core.exceptions import RuntimeEnvSetupError
         try:
-            w = self.rt._lease_worker(task["resources"], task["strategy"],
-                                      task.get("runtime_env"))
+            try:
+                w = self.rt._lease_worker(task["resources"],
+                                          task["strategy"],
+                                          task.get("runtime_env"))
+            except RuntimeEnvSetupError as e:
+                self._fail_queued(st, e)
+                return
         finally:
             with st.lock:
                 st.pending_leases -= 1
@@ -332,6 +338,19 @@ class TaskSubmitter:
         self._pump(st)
         # If the queue drained while this lease was in flight, the reaper
         # returns the unused grant after the linger window.
+
+    def _fail_queued(self, st: _KeyState, exc: BaseException) -> None:
+        """Terminal failure for every task queued under this scheduling
+        key (e.g. the runtime_env cannot materialize anywhere)."""
+        with st.lock:
+            victims, st.queue = list(st.queue), deque()
+        for rec in victims:
+            if rec.cancelled or rec.done:
+                continue
+            rec.done = True
+            self.rt._store_error_returns(
+                rec.task, TaskError.from_exception(exc, rec.task["name"]))
+            self._unpin_args(rec)
 
     def _unpin_args(self, rec: _TaskRecord) -> None:
         """Release in-flight argument pins exactly once (after the first
@@ -826,6 +845,11 @@ class ClusterRuntime:
             if resp.get("granted"):
                 return _LeasedWorker(resp["lease_id"],
                                      resp["worker_address"], addr)
+            if resp.get("env_error"):
+                # Deterministic env-materialization failure: retrying on
+                # another node re-runs the same broken spec. Fail fast.
+                from ray_tpu.core.exceptions import RuntimeEnvSetupError
+                raise RuntimeEnvSetupError(resp["env_error"])
         return None
 
     def _release_lease(self, w: _LeasedWorker) -> None:

@@ -9,8 +9,12 @@ worker's PYTHONPATH on the executing node (the role of the reference's
 runtime-env agent + GCS package store, _private/runtime_env/py_modules.py).
 Workers are cached per runtime-env hash (dedicated-worker behavior).
 
-Unsupported-in-this-image plugins (pip/conda/container) raise upfront
-rather than failing inside the worker pool.
+``pip`` environments (parity: _private/runtime_env/pip.py) build a venv
+per spec hash with --system-site-packages and install OFFLINE
+(``--no-index``): packages resolve from a ``find_links`` wheel directory
+or local paths only — this image has no network egress, so index installs
+fail fast with pip's own error. Workers for such envs run on the venv's
+interpreter. Conda/container plugins raise upfront.
 """
 
 from __future__ import annotations
@@ -22,8 +26,8 @@ import os
 import zipfile
 from typing import Any, Dict, List, Optional
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
-_KNOWN_UNSUPPORTED = {"pip", "conda", "container"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip"}
+_KNOWN_UNSUPPORTED = {"conda", "container"}
 _MAX_MODULE_ZIP = 64 << 20
 
 
@@ -101,7 +105,8 @@ def env_fingerprint(env: Optional[dict]) -> str:
 class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
-                 py_modules: Optional[List[str]] = None, **kwargs):
+                 py_modules: Optional[List[str]] = None,
+                 pip: Optional[Any] = None, **kwargs):
         super().__init__()
         if env_vars is not None:
             if not all(isinstance(k, str) and isinstance(v, str)
@@ -121,6 +126,26 @@ class RuntimeEnv(dict):
                 else:
                     packed.append(_pack_module(str(m)))
             self["py_modules"] = packed
+        if pip is not None:
+            # Normalize: ["pkg", ...] or {"packages": [...],
+            # "find_links": dir}. Stored small and hashable.
+            if isinstance(pip, (list, tuple)):
+                spec = {"packages": [str(p) for p in pip],
+                        "find_links": None}
+            elif isinstance(pip, dict):
+                spec = {"packages": [str(p) for p in pip.get("packages", [])],
+                        "find_links": pip.get("find_links")}
+            else:
+                raise TypeError("pip must be a list of requirements or a "
+                                "dict with packages/find_links")
+            if not spec["packages"]:
+                raise ValueError("pip spec has no packages")
+            if (spec["find_links"] is not None
+                    and not os.path.isdir(spec["find_links"])):
+                raise ValueError(
+                    f"pip find_links {spec['find_links']!r} is not a "
+                    "directory (offline installs need a local wheel dir)")
+            self["pip"] = spec
         for k in kwargs:
             if k in _KNOWN_UNSUPPORTED:
                 raise ValueError(
@@ -139,3 +164,94 @@ def validate_runtime_env(env: Optional[dict]) -> Optional[dict]:
     if isinstance(env, RuntimeEnv):
         return env.to_dict()
     return RuntimeEnv(**env).to_dict()
+
+
+_pip_env_locks: Dict[str, Any] = {}
+_pip_env_locks_guard = None
+
+
+def _pip_lock(key: str):
+    global _pip_env_locks_guard
+    import threading
+    if _pip_env_locks_guard is None:
+        _pip_env_locks_guard = threading.Lock()
+    with _pip_env_locks_guard:
+        return _pip_env_locks.setdefault(key, threading.Lock())
+
+
+def ensure_pip_env(spec: Dict[str, Any], session_dir: str) -> str:
+    """Daemon-side (runtime-env agent role, _private/runtime_env/pip.py):
+    materialize the venv for a pip spec and return its python executable.
+    Cached per spec hash; --system-site-packages keeps the image's baked
+    deps (jax et al.) visible; installs are strictly OFFLINE (--no-index
+    [--find-links dir]) because this image has no egress."""
+    import hashlib
+    import json
+    import subprocess
+    import sys
+
+    from ray_tpu.core.exceptions import RuntimeEnvSetupError
+
+    key = hashlib.sha256(json.dumps(spec, sort_keys=True).encode()
+                         ).hexdigest()[:16]
+    root = os.path.join(session_dir, "pip_envs", key)
+    py = os.path.join(root, "bin", "python")
+    marker = os.path.join(root, ".ready")
+    if os.path.exists(marker):
+        return py
+    # Per-spec build lock: the daemon's RPC server is threaded, and two
+    # concurrent leases for the same env must not race `venv` + `pip`
+    # into one directory (a corrupted build would read as a DETERMINISTIC
+    # env failure and fail-fast every queued task).
+    with _pip_lock(key):
+        if os.path.exists(marker):
+            return py
+        try:
+            _build_pip_env(spec, root, py)
+        except RuntimeEnvSetupError:
+            raise
+        except Exception as e:  # venv/ensurepip/site-probe failures
+            raise RuntimeEnvSetupError(
+                f"pip runtime_env venv build failed: {e!r}") from e
+        with open(marker, "w") as f:
+            f.write("ok")
+    return py
+
+
+def _build_pip_env(spec: Dict[str, Any], root: str, py: str) -> None:
+    import json
+    import subprocess
+    import sys
+
+    import shutil
+    shutil.rmtree(root, ignore_errors=True)   # clear any partial build
+    subprocess.run([sys.executable, "-m", "venv",
+                    "--system-site-packages", root],
+                   check=True, capture_output=True)
+    # When the PARENT interpreter is itself a venv (this image: /opt/venv),
+    # --system-site-packages points at the base python, not the parent —
+    # so the image's baked deps (jax, cloudpickle, ...) would vanish.
+    # A .pth in the child exposes the parent's site-packages explicitly.
+    import site
+    child_site = subprocess.run(
+        [py, "-c", "import site, json;"
+         "print(json.dumps(site.getsitepackages()))"],
+        check=True, capture_output=True, text=True)
+    child_dirs = json.loads(child_site.stdout)
+    parent_dirs = [d for d in site.getsitepackages()
+                   if d not in child_dirs and os.path.isdir(d)]
+    if child_dirs and parent_dirs:
+        with open(os.path.join(child_dirs[0], "_parent_site.pth"),
+                  "w") as f:
+            f.write("\n".join(parent_dirs) + "\n")
+    cmd = [py, "-m", "pip", "install", "--no-index",
+           "--disable-pip-version-check"]
+    if spec.get("find_links"):
+        cmd += ["--find-links", spec["find_links"]]
+    cmd += spec["packages"]
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    if out.returncode != 0:
+        from ray_tpu.core.exceptions import RuntimeEnvSetupError
+        raise RuntimeEnvSetupError(
+            f"pip runtime_env install failed (offline --no-index; provide "
+            f"find_links with local wheels): {out.stderr.strip()[-500:]}")

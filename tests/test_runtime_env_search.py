@@ -50,10 +50,12 @@ def test_py_modules_importable_in_worker(cluster, tmp_path):
     assert ray_tpu.get(use_single.remote(), timeout=120) == 7
 
 
-def test_pip_conda_still_rejected():
+def test_conda_still_rejected_pip_supported():
     from ray_tpu.runtime_env import RuntimeEnv
-    with pytest.raises(ValueError, match="package installation"):
-        RuntimeEnv(pip=["requests"])
+    # pip is now a SUPPORTED plugin (offline venv installs,
+    # tests/test_runtime_env_pip.py); conda/container remain gated.
+    env = RuntimeEnv(pip=["somepkg==1.0"])
+    assert env["pip"]["packages"] == ["somepkg==1.0"]
     with pytest.raises(ValueError, match="package installation"):
         RuntimeEnv(conda={"dependencies": ["x"]})
 
