@@ -181,5 +181,7 @@ def test_runtime_env_env_vars(cluster):
 
     assert rt.get(read_env.remote(), timeout=60) == "hello"
 
-    with pytest.raises(ValueError, match="pip"):
-        RuntimeEnv(pip=["requests"])
+    # pip is now a supported plugin (offline venvs,
+    # tests/test_runtime_env_pip.py); container remains gated.
+    with pytest.raises(ValueError, match="container"):
+        RuntimeEnv(container={"image": "x"})
