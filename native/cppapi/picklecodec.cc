@@ -371,7 +371,9 @@ class Decoder {
     return data_[pos_++];
   }
   void Skip(size_t n) {
-    if (pos_ + n > data_.size()) throw PickleError("truncated pickle");
+    // n > size-pos (not pos+n > size): a corrupt 64-bit length must not
+    // wrap the addition and sneak past the bounds check.
+    if (n > data_.size() - pos_) throw PickleError("truncated pickle");
     pos_ += n;
   }
   uint32_t ReadU32() {
@@ -387,7 +389,7 @@ class Decoder {
     return v;
   }
   std::string ReadStr(uint64_t n) {
-    if (pos_ + n > data_.size()) throw PickleError("truncated pickle");
+    if (n > data_.size() - pos_) throw PickleError("truncated pickle");
     std::string s = data_.substr(pos_, n);
     pos_ += n;
     return s;

@@ -135,14 +135,18 @@ Value Client::Call(const std::string& method,
   return payload;
 }
 
-ObjectRef Client::Put(const Value& value) {
-  // put_id makes the call idempotent under the RPC layer's at-least-once
-  // delivery (ray_tpu/client/server.py rpc_cp_put dedupe).
+// Submission ids make put/task/actor calls idempotent under the RPC
+// layer's at-least-once delivery (ray_tpu/client/server.py dedupe).
+static std::string NextSubmissionId(const std::string& session) {
   static std::atomic<uint64_t> counter{0};
-  std::string put_id = session_ + "-" + std::to_string(++counter);
+  return session + "-" + std::to_string(++counter);
+}
+
+ObjectRef Client::Put(const Value& value) {
   Value resp = Call("cp_put",
                     {{Value::Str("blob"), Value::Bytes(PickleDumps(value))},
-                     {Value::Str("put_id"), Value::Str(put_id)}});
+                     {Value::Str("put_id"),
+                      Value::Str(NextSubmissionId(session_))}});
   return RefFromValue(PickleLoads(resp.Find("ref")->AsBytes()));
 }
 
@@ -206,7 +210,9 @@ ObjectRef Client::Task(
                      {Value::Str("blob"), Value::None()},
                      {Value::Str("args_blob"), EncArgs(args)},
                      {Value::Str("opts"), OptsDict(opts)},
-                     {Value::Str("import_path"), Value::Str(import_path)}});
+                     {Value::Str("import_path"), Value::Str(import_path)},
+                     {Value::Str("submission_id"),
+                      Value::Str(NextSubmissionId(session_))}});
   Value refs = PickleLoads(resp.Find("refs")->AsBytes());
   return RefFromValue(refs.AsSeq().at(0));
 }
@@ -220,7 +226,9 @@ ActorHandle Client::CreateActor(
             {Value::Str("blob"), Value::None()},
             {Value::Str("args_blob"), EncArgs(args)},
             {Value::Str("opts"), OptsDict(opts)},
-            {Value::Str("import_path"), Value::Str(import_path)}});
+            {Value::Str("import_path"), Value::Str(import_path)},
+            {Value::Str("submission_id"),
+             Value::Str(NextSubmissionId(session_))}});
   Value actor = PickleLoads(resp.Find("actor")->AsBytes());
   if (actor.kind != Value::Kind::Actor)
     throw RpcError("expected an actor handle in proxy response");
@@ -234,7 +242,9 @@ ObjectRef Client::ActorCall(const ActorHandle& actor,
                     {{Value::Str("actor_id"), Value::Bytes(actor.id)},
                      {Value::Str("method_name"), Value::Str(method)},
                      {Value::Str("args_blob"), EncArgs(args)},
-                     {Value::Str("opts"), Value::Dict({})}});
+                     {Value::Str("opts"), Value::Dict({})},
+                     {Value::Str("submission_id"),
+                      Value::Str(NextSubmissionId(session_))}});
   Value refs = PickleLoads(resp.Find("refs")->AsBytes());
   return RefFromValue(refs.AsSeq().at(0));
 }

@@ -135,7 +135,8 @@ class StandardAutoscaler:
                  node_types: Dict[str, dict],
                  idle_timeout_s: float = 30.0,
                  update_interval_s: float = 1.0,
-                 max_workers: int = 20):
+                 max_workers: int = 20,
+                 zombie_grace_s: float = 600.0):
         from ray_tpu.cluster.protocol import get_client
         self.conductor = get_client(conductor_address)
         self.provider = provider
@@ -143,7 +144,14 @@ class StandardAutoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.update_interval_s = update_interval_s
         self.max_workers = max_workers
+        # How long a provider node may run with ZERO registered cluster
+        # nodes before it is terminated (covers boot time; after that it's
+        # a cost leak — dead slice or broken startup script). The default
+        # must exceed worst-case multi-host slice provisioning+boot, or
+        # scale-up churns: launch → terminate-at-grace → relaunch.
+        self.zombie_grace_s = zombie_grace_s
         self._idle_since: Dict[bytes, float] = {}
+        self._zombie_since: Dict[str, float] = {}
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
 
@@ -197,6 +205,35 @@ class StandardAutoscaler:
                 self.provider.terminate_node(provider_id)
                 for nid in nids:
                     self._idle_since.pop(nid, None)
+        # Prune idle tracking for nodes that vanished from the cluster
+        # view (died / deregistered) so stale entries don't accumulate.
+        live_nids = {n["node_id"] for n in load["nodes"]}
+        for nid in list(self._idle_since):
+            if nid not in live_nids:
+                self._idle_since.pop(nid, None)
+        # Zombie providers: a non-terminated provider node with NO
+        # registered cluster node (every host of the slice died, or the
+        # startup script never joined). Scale-down above only examines
+        # providers with live cluster nodes, so without this sweep such a
+        # VM would never be terminated — a pure cost leak. "Registered" is
+        # judged from the provider's own node_id_map over ALL live nodes
+        # (head included — per_provider excludes it); a provider whose map
+        # is empty cannot distinguish booting from dead and opts out of
+        # termination entirely (NodeProvider.node_id_map contract).
+        if by_node_id:
+            registered = {by_node_id[nid] for nid in live_nids
+                          if nid in by_node_id}
+            for pid, _t in workers:
+                if pid in registered:
+                    self._zombie_since.pop(pid, None)
+                elif now - self._zombie_since.setdefault(pid, now) > \
+                        self.zombie_grace_s:
+                    self.provider.terminate_node(pid)
+                    self._zombie_since.pop(pid, None)
+        alive_pids = {pid for pid, _t in workers}
+        for pid in list(self._zombie_since):
+            if pid not in alive_pids:
+                self._zombie_since.pop(pid, None)
         return launched
 
     def start(self) -> None:

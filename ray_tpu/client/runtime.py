@@ -124,11 +124,17 @@ class ClientRuntime:
     def _dec(self, blob: bytes) -> Any:
         return common.loads(blob, common.handle_from_marker)
 
+    @staticmethod
+    def _sid() -> str:
+        """Fresh submission id: lets the proxy dedupe a resend of the same
+        logical call (at-least-once RPC delivery) without double-executing."""
+        import os as _os
+        return _os.urandom(8).hex()
+
     # -- objects -----------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
-        import os as _os
         return self._dec(self._call("cp_put", blob=self._enc(value),
-                                    put_id=_os.urandom(8).hex())["ref"])
+                                    put_id=self._sid())["ref"])
 
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
@@ -150,14 +156,15 @@ class ClientRuntime:
     def submit_task(self, desc, blob, args, kwargs, opts) -> List[ObjectRef]:
         resp = self._call("cp_task", desc=desc, blob=blob,
                           args_blob=self._enc((list(args), dict(kwargs))),
-                          opts=opts)
+                          opts=opts, submission_id=self._sid())
         return self._dec(resp["refs"])
 
     def create_actor(self, desc, blob, args, kwargs, opts, methods,
                      is_async) -> ActorHandle:
         resp = self._call("cp_actor_create", desc=desc, blob=blob,
                           args_blob=self._enc((list(args), dict(kwargs))),
-                          opts=opts, methods=methods, is_async=is_async)
+                          opts=opts, methods=methods, is_async=is_async,
+                          submission_id=self._sid())
         return self._dec(resp["actor"])
 
     def submit_actor_task(self, handle: ActorHandle, method_name: str, args,
@@ -166,7 +173,7 @@ class ClientRuntime:
                           actor_id=handle._rt_actor_id.binary(),
                           method_name=method_name,
                           args_blob=self._enc((list(args), dict(kwargs))),
-                          opts=opts)
+                          opts=opts, submission_id=self._sid())
         return self._dec(resp["refs"])
 
     def kill_actor(self, handle: ActorHandle, no_restart: bool = True) -> None:

@@ -24,7 +24,8 @@ import os
 import pickle
 import threading
 import traceback
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.client import common
 from ray_tpu.core.actor import ActorClass, ActorHandle
@@ -54,11 +55,53 @@ class _Session:
         self.meta = meta
         self.refs: Dict[bytes, ObjectRef] = {}
         self.actors: Dict[bytes, ActorHandle] = {}
-        # put_id -> encoded ref: makes cp_put idempotent under the RPC
-        # layer's at-least-once delivery (a retried put must not mint a
-        # second object). Bounded FIFO.
-        self.put_seen: Dict[str, bytes] = {}
+        # submission_id -> cached response (or in-progress Event): makes
+        # cp_put/cp_task/cp_actor_create/cp_actor_task idempotent under the
+        # RPC layer's at-least-once delivery (a retried submission whose
+        # reply was lost must not mint a second object / run the task
+        # twice). The Event covers the race where the retry arrives while
+        # the original is STILL EXECUTING: the duplicate blocks until the
+        # first attempt's response is recorded. Bounded FIFO.
+        self.seen: Dict[str, Any] = {}
+        self._settled: "deque[str]" = deque()  # eviction order, O(1)
         self.lock = threading.Lock()
+
+    def begin(self, submission_id: Optional[str]
+              ) -> Tuple[Optional[dict], bool]:
+        """-> (cached_response, is_owner). Owner executes and must record();
+        a duplicate waits for the owner's response and replays it."""
+        if submission_id is None:
+            return None, True
+        with self.lock:
+            cur = self.seen.get(submission_id)
+            if cur is None:
+                self.seen[submission_id] = threading.Event()
+                return None, True
+        if isinstance(cur, threading.Event):
+            cur.wait(timeout=600.0)
+            with self.lock:
+                cur = self.seen.get(submission_id)
+            if isinstance(cur, threading.Event) or cur is None:
+                return {"ok": False,
+                        "error": "duplicate submission still in progress"}, \
+                    False
+        return cur, False
+
+    def record(self, submission_id: Optional[str], resp: dict) -> dict:
+        if submission_id is not None:
+            with self.lock:
+                prev = self.seen.get(submission_id)
+                self.seen[submission_id] = resp
+                self._settled.append(submission_id)
+                # Evict oldest settled entries; pending Events are never in
+                # _settled and so survive until their owner records.
+                while len(self.seen) > 4096 and self._settled:
+                    old = self._settled.popleft()
+                    if not isinstance(self.seen.get(old), threading.Event):
+                        self.seen.pop(old, None)
+            if isinstance(prev, threading.Event):
+                prev.set()
+        return resp
 
 
 class ClientProxy:
@@ -131,6 +174,24 @@ class ClientProxy:
         return {"ok": False, "error": f"{type(e).__name__}: {e}",
                 "traceback": traceback.format_exc(), "exc": exc}
 
+    def _idempotent(self, session: str, submission_id: Optional[str],
+                    body) -> dict:
+        """Session lookup + begin/record dedupe around ``body(s) -> resp``.
+        Failures are recorded too: a retried submission replays the
+        original attempt's error instead of executing a second time."""
+        try:
+            s = self._session(session)
+        except BaseException as e:  # noqa: BLE001
+            return self._fail(e)
+        cached, owner = s.begin(submission_id)
+        if not owner:
+            return cached
+        try:
+            resp = body(s)
+        except BaseException as e:  # noqa: BLE001
+            resp = self._fail(e)
+        return s.record(submission_id, resp)
+
     # -- lifecycle ---------------------------------------------------------
     def rpc_cp_connect(self, meta: Optional[dict] = None) -> dict:
         session_id = os.urandom(8).hex()
@@ -162,23 +223,10 @@ class ClientProxy:
     # -- objects -----------------------------------------------------------
     def rpc_cp_put(self, session: str, blob: bytes,
                    put_id: Optional[str] = None) -> dict:
-        try:
-            s = self._session(session)
-            if put_id is not None:
-                with s.lock:
-                    enc = s.put_seen.get(put_id)
-                if enc is not None:
-                    return {"ok": True, "ref": enc}
+        def body(s):
             ref = self._rt.put(self._dec(s, blob))
-            enc = self._enc(s, ref)
-            if put_id is not None:
-                with s.lock:
-                    s.put_seen[put_id] = enc
-                    while len(s.put_seen) > 1024:
-                        s.put_seen.pop(next(iter(s.put_seen)))
-            return {"ok": True, "ref": enc}
-        except BaseException as e:  # noqa: BLE001
-            return self._fail(e)
+            return {"ok": True, "ref": self._enc(s, ref)}
+        return self._idempotent(session, put_id, body)
 
     def rpc_cp_get(self, session: str, oids: List[bytes],
                    timeout: Optional[float] = None) -> dict:
@@ -211,19 +259,19 @@ class ClientProxy:
     def rpc_cp_task(self, session: str, desc: Optional[FunctionDescriptor],
                     blob: Optional[bytes], args_blob: bytes,
                     opts: Optional[dict] = None,
-                    import_path: Optional[str] = None) -> dict:
-        try:
-            s = self._session(session)
+                    import_path: Optional[str] = None,
+                    submission_id: Optional[str] = None) -> dict:
+        def body(s):
+            d, b = desc, blob
             if import_path is not None:
                 fn = _import_path(import_path)
-                desc, blob = FunctionDescriptor.for_callable(fn)
+                d, b = FunctionDescriptor.for_callable(fn)
             topts = (opts if isinstance(opts, TaskOptions)
                      else make_task_options(None, **(opts or {})))
             args, kwargs = self._dec(s, args_blob)
-            refs = self._rt.submit_task(desc, blob, args, kwargs, topts)
+            refs = self._rt.submit_task(d, b, args, kwargs, topts)
             return {"ok": True, "refs": self._enc(s, refs)}
-        except BaseException as e:  # noqa: BLE001
-            return self._fail(e)
+        return self._idempotent(session, submission_id, body)
 
     # -- actors ------------------------------------------------------------
     def rpc_cp_actor_create(self, session: str,
@@ -232,31 +280,30 @@ class ClientProxy:
                             opts: Optional[dict] = None,
                             methods: Optional[dict] = None,
                             is_async: bool = False,
-                            import_path: Optional[str] = None) -> dict:
-        try:
-            s = self._session(session)
+                            import_path: Optional[str] = None,
+                            submission_id: Optional[str] = None) -> dict:
+        def body(s):
+            d, b, m, asy = desc, blob, methods, is_async
             if import_path is not None:
                 cls = _import_path(import_path)
-                desc, blob = FunctionDescriptor.for_callable(cls)
-                methods = ActorClass._scan_methods(cls)
+                d, b = FunctionDescriptor.for_callable(cls)
+                m = ActorClass._scan_methods(cls)
                 import inspect
-                is_async = any(
-                    inspect.iscoroutinefunction(getattr(cls, m))
-                    for m in methods)
+                asy = any(inspect.iscoroutinefunction(getattr(cls, name))
+                          for name in m)
             aopts = (opts if isinstance(opts, ActorOptions)
                      else make_actor_options(None, **(opts or {})))
             args, kwargs = self._dec(s, args_blob)
-            handle = self._rt.create_actor(desc, blob, args, kwargs, aopts,
-                                           methods or {}, is_async)
+            handle = self._rt.create_actor(d, b, args, kwargs, aopts,
+                                           m or {}, asy)
             return {"ok": True, "actor": self._enc(s, handle)}
-        except BaseException as e:  # noqa: BLE001
-            return self._fail(e)
+        return self._idempotent(session, submission_id, body)
 
     def rpc_cp_actor_task(self, session: str, actor_id: bytes,
                           method_name: str, args_blob: bytes,
-                          opts: Optional[dict] = None) -> dict:
-        try:
-            s = self._session(session)
+                          opts: Optional[dict] = None,
+                          submission_id: Optional[str] = None) -> dict:
+        def body(s):
             with s.lock:
                 handle = s.actors.get(actor_id)
             if handle is None:
@@ -268,8 +315,7 @@ class ClientProxy:
             refs = self._rt.submit_actor_task(handle, method_name, args,
                                               kwargs, topts)
             return {"ok": True, "refs": self._enc(s, refs)}
-        except BaseException as e:  # noqa: BLE001
-            return self._fail(e)
+        return self._idempotent(session, submission_id, body)
 
     def rpc_cp_actor_kill(self, session: str, actor_id: bytes,
                           no_restart: bool = True) -> dict:

@@ -829,32 +829,53 @@ class NodeDaemon:
             self.store.release(oid)
 
     def rpc_push_chunk(self, oid: bytes, offset: int, total: int,
-                       chunk: bytes) -> dict:
+                       chunk: bytes, stream: Optional[str] = None) -> dict:
         """Receive one chunk of a sender-initiated push (push_manager.h
         role). Chunks arrive in order on one connection; the first chunk
-        creates the buffer, the last seals + registers the location. A
-        concurrent local pull of the same object wins ties (create raises
-        already-exists → reject the push; pull is the correctness path)."""
+        creates the buffer, the last seals + registers the location. Each
+        push carries a sender-generated ``stream`` id: a chunk from a
+        DIFFERENT stream than the in-progress one is rejected without
+        touching that push (two senders racing must not destroy each
+        other's partial writes). A concurrent local pull of the same object
+        wins ties (create raises already-exists → reject the push; pull is
+        the correctness path)."""
         with self._push_lock:  # guards the dict only — never I/O
             st = self._push_partial.get(oid)
             if st is None:
                 if offset != 0:
                     return {"reject": True}  # stale resumed push
+                # Claim the oid with an empty entry; the store create
+                # happens below, outside this lock (store I/O must not
+                # serialize every concurrent push through one mutex).
+                st = self._push_partial[oid] = {
+                    "buf": None, "off": 0, "total": total, "stream": stream,
+                    "ts": time.monotonic(), "lock": threading.Lock()}
+            elif st.get("stream") != stream:
+                return {"reject": True}  # another sender's push in progress
+        with st["lock"]:
+            if st["buf"] is None:
                 if self.store.contains(oid):
+                    with self._push_lock:
+                        self._push_partial.pop(oid, None)
                     return {"done": True}
                 try:
-                    buf = self.store.create(oid, total)
+                    st["buf"] = self.store.create(oid, total)
                 except Exception:
-                    return {"done": True}  # being written by pull/another push
-                st = self._push_partial[oid] = {
-                    "buf": buf, "off": 0, "total": total,
-                    "ts": time.monotonic(), "lock": threading.Lock()}
-        with st["lock"]:
+                    with self._push_lock:
+                        self._push_partial.pop(oid, None)
+                    return {"done": True}  # being written by a pull
+            if st["total"] == total and offset + len(chunk) <= st["off"]:
+                # Duplicate of an already-applied chunk: the RPC layer's
+                # at-least-once retry resent a chunk whose ack was lost.
+                # Ack idempotently — aborting here would destroy our own
+                # push.
+                return {"ok": True}
             if offset != st["off"] or st["total"] != total:
-                # Out-of-sequence (competing sender, or a sender that died
-                # and restarted): abort the push and DELETE the unsealed
-                # entry — an orphaned CREATED object would wedge every
-                # future pull (create→already-exists, get→never sealed).
+                # Out-of-sequence WITHIN one stream (sender died and
+                # resumed under the same id): abort the push and DELETE the
+                # unsealed entry — an orphaned CREATED object would wedge
+                # every future pull (create→already-exists, get→never
+                # sealed).
                 with self._push_lock:
                     self._push_partial.pop(oid, None)
                 try:

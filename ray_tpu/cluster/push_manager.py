@@ -74,6 +74,12 @@ class PushManager:
                     self._bytes += size
                 admitted = size
                 cli = get_client(target)
+                # Per-push stream id: lets the receiver tell this push's
+                # chunks apart from a competing sender's (node_daemon
+                # rpc_push_chunk rejects cross-stream chunks instead of
+                # destroying the in-progress entry).
+                import os as _os
+                stream = _os.urandom(8).hex()
                 off = 0
                 while off < size:
                     n = min(PUSH_CHUNK, size - off)
@@ -82,7 +88,7 @@ class PushManager:
                     resp = cli.call("push_chunk", oid=key, offset=off,
                                     total=size,
                                     chunk=bytes(view[off:off + n]),
-                                    _timeout=30.0)
+                                    stream=stream, _timeout=30.0)
                     if resp.get("done") or resp.get("reject"):
                         return  # destination has it / is pulling it already
                     off += n

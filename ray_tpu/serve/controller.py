@@ -158,8 +158,13 @@ class ServeController:
         ).remote(spec["cls_blob"], spec["init_args_blob"])
         self._replica_started[handle._rt_actor_id] = time.time()
         if spec.get("user_config") is not None:
+            # The reconfigure wait covers __init__ too (the actor call
+            # queues behind construction), so its deadline is the
+            # deployment's OWN init grace — a 10-minute model load with
+            # init_grace_s=900 must not fail at a fixed 120s, and a
+            # fail-fast init_grace_s=15 must not stall reconcile for 120s.
             rt.get(handle.reconfigure.remote(spec["user_config"]),
-                   timeout=120)
+                   timeout=float(spec.get("init_grace_s", 120.0)))
         return handle
 
     def _reconcile_once(self) -> None:

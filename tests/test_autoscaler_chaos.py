@@ -83,6 +83,54 @@ def test_autoscaler_scales_down_idle(cluster):
         scaler.stop()
 
 
+def test_autoscaler_terminates_zombie_provider(cluster):
+    """A provider node that never registers a cluster node (dead slice or
+    broken startup script) is terminated after the zombie grace period —
+    otherwise the VM would leak forever since scale-down only examines
+    providers with live cluster nodes."""
+    from ray_tpu.autoscaler import NodeProvider, StandardAutoscaler
+
+    class ZombieProvider(NodeProvider):
+        def __init__(self):
+            self.nodes = {"zombie-1": "cpu2"}
+            self.terminated = []
+
+        def create_node(self, node_type):
+            raise AssertionError("no demand in this test")
+
+        def terminate_node(self, pid):
+            self.terminated.append(pid)
+            self.nodes.pop(pid, None)
+
+        def non_terminated_nodes(self):
+            return list(self.nodes.items())
+
+        def node_id_map(self):
+            # A mapping-capable provider (zombie-1 has no cluster node to
+            # map). Providers returning {} opt out of termination.
+            return {b"some-other-cluster-node": "other-pid"}
+
+    types = {"cpu2": {"resources": {"CPU": 2}, "max_workers": 4}}
+    provider = ZombieProvider()
+    scaler = StandardAutoscaler(cluster.address, provider, types,
+                                idle_timeout_s=60, zombie_grace_s=0.5)
+    scaler.update()                      # seeds the zombie clock
+    assert not provider.terminated      # inside the grace window
+    time.sleep(0.7)
+    scaler.update()
+    assert provider.terminated == ["zombie-1"]
+
+    # A provider that CANNOT map node ids must never be zombie-terminated.
+    blind = ZombieProvider()
+    blind.node_id_map = lambda: {}
+    scaler2 = StandardAutoscaler(cluster.address, blind, types,
+                                 idle_timeout_s=60, zombie_grace_s=0.1)
+    scaler2.update()
+    time.sleep(0.3)
+    scaler2.update()
+    assert blind.terminated == []
+
+
 def test_rpc_delay_injection(cluster):
     from ray_tpu import config
     from ray_tpu.cluster.protocol import get_client

@@ -112,6 +112,67 @@ def test_client_exception_and_named_actor(proxy):
     assert "NAMED 7" in out
 
 
+def test_client_submission_dedupe(proxy, tmp_path):
+    """A resent cp_task / cp_actor_create / cp_actor_task with the same
+    submission_id (at-least-once RPC delivery replaying a call whose reply
+    was lost) returns the cached refs and does NOT execute twice."""
+    from ray_tpu.client import common
+    from ray_tpu.core.task_spec import FunctionDescriptor
+
+    sess = proxy.rpc_cp_connect()["session"]
+    marker = str(tmp_path / "ran")
+
+    def bump(path):
+        with open(path, "a") as f:
+            f.write("x")
+        return "done"
+
+    desc, blob = FunctionDescriptor.for_callable(bump)
+    args_blob = common.dumps(([marker], {}), common.marker_for)
+    r1 = proxy.rpc_cp_task(sess, desc, blob, args_blob,
+                           submission_id="sub-1")
+    r2 = proxy.rpc_cp_task(sess, desc, blob, args_blob,
+                           submission_id="sub-1")
+    assert r1["ok"] and r2 is r1  # replay: the exact cached response
+    s = proxy._session(sess)
+    refs = proxy._dec(s, r1["refs"])
+    assert ray_tpu.get(refs[0], timeout=30) == "done"
+    time.sleep(0.3)
+    assert open(marker).read() == "x"  # ran exactly once
+
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    cdesc, cblob = FunctionDescriptor.for_callable(Counter)
+    no_args = common.dumps(([], {}), common.marker_for)
+    a1 = proxy.rpc_cp_actor_create(
+        sess, cdesc, cblob, no_args, methods={"incr": {}},
+        submission_id="act-1")
+    a2 = proxy.rpc_cp_actor_create(
+        sess, cdesc, cblob, no_args, methods={"incr": {}},
+        submission_id="act-1")
+    assert a1["ok"] and a2 is a1  # one actor, not two
+    handle = proxy._dec(s, a1["actor"])
+    aid = handle._rt_actor_id.binary()
+    t1 = proxy.rpc_cp_actor_task(sess, aid, "incr", no_args,
+                                 submission_id="call-1")
+    t2 = proxy.rpc_cp_actor_task(sess, aid, "incr", no_args,
+                                 submission_id="call-1")
+    assert t1["ok"] and t2 is t1
+    ref = proxy._dec(s, t1["refs"])[0]
+    assert ray_tpu.get(ref, timeout=30) == 1
+    # A FRESH call (new submission_id) does execute.
+    t3 = proxy.rpc_cp_actor_task(sess, aid, "incr", no_args,
+                                 submission_id="call-2")
+    assert ray_tpu.get(proxy._dec(s, t3["refs"])[0], timeout=30) == 2
+    proxy.rpc_cp_disconnect(sess)
+
+
 def test_client_session_release(proxy):
     _run_client(proxy.address, """
         refs = [ray_tpu.put(i) for i in range(20)]

@@ -102,3 +102,27 @@ def test_push_chunk_rejects_existing(cluster):
         "push_chunk", oid=key, offset=0, total=12, chunk=b"x" * 12)
     assert resp.get("done")
     assert rt.get(ref) == b"already-here"
+
+
+def test_push_chunk_competing_stream_rejected(cluster):
+    """A second sender's offset-0 chunk must NOT destroy the first sender's
+    in-progress push: the intruder is rejected, the original stream keeps
+    streaming to completion (node_daemon.rpc_push_chunk stream tagging)."""
+    runtime = core_api._runtime
+    cli = get_client(runtime.daemon_address)
+    oid = b"push-race-" + b"\x01" * 6  # 16-byte store key
+    total = 8
+    # Stream A starts (half the payload).
+    ra = cli.call("push_chunk", oid=oid, offset=0, total=total,
+                  chunk=b"AAAA", stream="stream-a")
+    assert ra.get("ok")
+    # Stream B barges in at offset 0 — rejected, A's entry untouched.
+    rb = cli.call("push_chunk", oid=oid, offset=0, total=total,
+                  chunk=b"BBBB", stream="stream-b")
+    assert rb.get("reject")
+    # Stream A finishes; the sealed object holds A's bytes.
+    ra2 = cli.call("push_chunk", oid=oid, offset=4, total=total,
+                   chunk=b"aaaa", stream="stream-a")
+    assert ra2.get("done")
+    info = cli.call("object_info", oid=oid)
+    assert info["found"]
