@@ -57,6 +57,8 @@ enum Op : uint8_t {
   OP_STATS = 7,
   OP_LIST = 8,
   OP_GET_COPY = 9,  // small-object fast path: data inline, no refcount
+  OP_PUT_INLINE = 10,    // create+write+seal in ONE round trip
+  OP_GET_COPY_BATCH = 11,  // N inline gets in ONE round trip
 };
 
 enum Status : uint8_t {
@@ -97,8 +99,10 @@ enum ObjState { CREATED, SEALED, SPILLED };
 
 struct Object {
   ObjState state = CREATED;
-  uint64_t size = 0;
-  int refcount = 0;  // sum of per-connection references
+  uint64_t size = 0;      // logical bytes (what GET reports)
+  uint64_t capacity = 0;  // shm file bytes (>= size when recycled)
+  int refcount = 0;       // sum of per-connection references
+  bool pending_delete = false;  // delete deferred until refcount drains
   std::string shm_name;
   uint64_t lru_tick = 0;
   std::set<int> creators;  // fd that created (for cleanup on disconnect)
@@ -134,6 +138,10 @@ class Store {
 
   uint64_t used_ = 0, spilled_bytes_ = 0, tick_ = 0;
   uint64_t num_evictions_ = 0, num_spills_ = 0, num_restores_ = 0;
+  uint64_t pool_bytes_ = 0, pool_counter_ = 0, num_recycles_ = 0;
+  // Smallest segment worth recycling: below this the fault+zero cost of a
+  // fresh segment is noise and pooling would just fragment the budget.
+  static constexpr uint64_t kRecycleMin = 256 << 10;
 
   std::string shm_name_for(const ObjectId& id) const {
     return "/" + prefix_ + hex(id);
@@ -141,27 +149,52 @@ class Store {
   std::string spill_path_for(const ObjectId& id) const {
     return spill_dir_ + "/" + hex(id);
   }
+  static std::string dev_path(const std::string& shm_name) {
+    return "/dev/shm" + shm_name;  // shm_name starts with "/"
+  }
 
   Status create(const ObjectId& id, uint64_t size, int fd) {
     if (objects_.count(id)) return ST_EXISTS;
     if (size > capacity_) return ST_OOM;
-    if (used_ + size > capacity_ && !evict(used_ + size - capacity_))
+    if (used_ + pool_bytes_ + size > capacity_ &&
+        !evict(used_ + pool_bytes_ + size - capacity_))
       return ST_OOM;
     std::string name = shm_name_for(id);
-    int sfd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
-    if (sfd < 0) return ST_ERR;
-    if (ftruncate(sfd, (off_t)size) != 0) {
-      close(sfd);
-      shm_unlink(name.c_str());
-      return ST_OOM;
+    uint64_t cap = size;
+    bool recycled = false;
+    // Recycle a retired segment when one fits without gross waste: its
+    // tmpfs pages are already faulted in, so the client's fill is a plain
+    // memcpy instead of a per-page zero-fill fault storm (the plasma-arena
+    // effect, reference plasma/dlmalloc.cc, without the arena).
+    auto pit = pool_.lower_bound(size);
+    if (size >= kRecycleMin && pit != pool_.end() &&
+        pit->first <= size + std::max<uint64_t>(size, 8ull << 20)) {
+      if (rename(dev_path(pit->second).c_str(),
+                 dev_path(name).c_str()) == 0) {
+        cap = pit->first;
+        pool_bytes_ -= cap;
+        pool_.erase(pit);
+        num_recycles_++;
+        recycled = true;
+      }
     }
-    close(sfd);
+    if (!recycled) {
+      int sfd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+      if (sfd < 0) return ST_ERR;
+      if (ftruncate(sfd, (off_t)size) != 0) {
+        close(sfd);
+        shm_unlink(name.c_str());
+        return ST_OOM;
+      }
+      close(sfd);
+    }
     Object o;
     o.size = size;
+    o.capacity = cap;
     o.shm_name = name;
     o.creators.insert(fd);
     objects_[id] = std::move(o);
-    used_ += size;
+    used_ += cap;
     return ST_OK;
   }
 
@@ -179,7 +212,8 @@ class Store {
   // GET: returns ST_OK (+size) when sealed & resident; restores spilled.
   Status get(const ObjectId& id, uint64_t* size) {
     auto it = objects_.find(id);
-    if (it == objects_.end()) return ST_NOT_FOUND;
+    if (it == objects_.end() || it->second.pending_delete)
+      return ST_NOT_FOUND;  // deleted-with-live-readers: no NEW refs
     Object& o = it->second;
     if (o.state == CREATED) return ST_NOT_SEALED;
     if (o.state == SPILLED && !restore(id, o)) return ST_ERR;
@@ -209,47 +243,92 @@ class Store {
     auto it = objects_.find(id);
     if (it == objects_.end()) return ST_NOT_FOUND;
     Object& o = it->second;
+    if (o.refcount > 0 && o.state != SPILLED) {
+      // Live readers still map these pages: defer (plasma-style) so the
+      // segment cannot be recycled under a zero-copy numpy view. The last
+      // release destroys it (add_ref hook below).
+      o.pending_delete = true;
+      return ST_OK;
+    }
+    destroy(it);
+    return ST_OK;
+  }
+
+  void destroy(std::unordered_map<ObjectId, Object, ObjectIdHash>::iterator
+                   it) {
+    Object& o = it->second;
     if (o.state == SPILLED) {
-      unlink(spill_path_for(id).c_str());
+      unlink(spill_path_for(it->first).c_str());
       spilled_bytes_ -= o.size;
     } else {
-      shm_unlink(o.shm_name.c_str());
-      used_ -= o.size;
+      retire_segment(o.shm_name, o.capacity);
+      used_ -= o.capacity;
     }
     objects_.erase(it);
-    return ST_OK;
+  }
+
+  // Retire a segment: into the recycle pool when it is big enough to be
+  // worth the resident pages, else unlink. Pool budget is half the store:
+  // pool pages are the FIRST thing eviction reclaims, so a generous pool
+  // costs nothing under pressure but keeps the inode set stable under
+  // put/delete cycling (stable inodes are what the client's write-mapping
+  // cache keys on).
+  void retire_segment(const std::string& name, uint64_t cap) {
+    if (cap >= kRecycleMin && pool_bytes_ + cap <= capacity_ / 2) {
+      std::string pname =
+          "/" + prefix_ + "pool" + std::to_string(pool_counter_++);
+      if (rename(dev_path(name).c_str(), dev_path(pname).c_str()) == 0) {
+        pool_.emplace(cap, std::move(pname));
+        pool_bytes_ += cap;
+        return;
+      }
+    }
+    shm_unlink(name.c_str());
   }
 
   bool contains(const ObjectId& id) {
     auto it = objects_.find(id);
-    return it != objects_.end() && it->second.state != CREATED;
+    return it != objects_.end() && it->second.state != CREATED &&
+           !it->second.pending_delete;
   }
 
   void add_ref(const ObjectId& id, int n) {
     auto it = objects_.find(id);
-    if (it != objects_.end()) it->second.refcount += n;
+    if (it == objects_.end()) return;
+    it->second.refcount += n;
+    if (it->second.refcount <= 0 && it->second.pending_delete) destroy(it);
   }
 
   // Evict LRU sealed, refcount==0 objects until `need` bytes are freed.
   // Spills to disk if a spill dir is configured, else drops (objects are
   // recoverable via lineage at the framework layer).
   bool evict(uint64_t need) {
+    uint64_t freed = 0;
+    // Recycle-pool pages first: reclaiming them costs nothing (no object
+    // dies, no spill I/O). Largest first.
+    while (freed < need && !pool_.empty()) {
+      auto pit = std::prev(pool_.end());
+      shm_unlink(pit->second.c_str());
+      freed += pit->first;
+      pool_bytes_ -= pit->first;
+      pool_.erase(pit);
+    }
+    if (freed >= need) return true;
     std::vector<std::pair<uint64_t, ObjectId>> cands;
     for (auto& [id, o] : objects_)
       if (o.state == SEALED && o.refcount == 0)
         cands.push_back({o.lru_tick, id});
     std::sort(cands.begin(), cands.end(),
               [](auto& a, auto& b) { return a.first < b.first; });
-    uint64_t freed = 0;
     for (auto& [_, id] : cands) {
       if (freed >= need) break;
       Object& o = objects_[id];
-      freed += o.size;
+      freed += o.capacity;
       if (!spill_dir_.empty() && spill(id, o)) {
         num_spills_++;
       } else {
         shm_unlink(o.shm_name.c_str());
-        used_ -= o.size;
+        used_ -= o.capacity;
         objects_.erase(id);
       }
       num_evictions_++;
@@ -258,6 +337,7 @@ class Store {
   }
 
   std::unordered_map<ObjectId, Object, ObjectIdHash> objects_;
+  std::multimap<uint64_t, std::string> pool_;  // capacity -> shm name
   std::string prefix_;
   uint64_t capacity_;
   std::string spill_dir_;
@@ -292,15 +372,18 @@ class Store {
       unlink(path.c_str());
       return false;
     }
+    // Spill frees the whole segment (no recycle: eviction's purpose is to
+    // RELEASE memory, pooling would keep the pages resident).
     shm_unlink(o.shm_name.c_str());
-    used_ -= o.size;
+    used_ -= o.capacity;
     spilled_bytes_ += o.size;
     o.state = SPILLED;
     return true;
   }
 
   bool restore(const ObjectId& id, Object& o) {
-    if (used_ + o.size > capacity_ && !evict(used_ + o.size - capacity_))
+    if (used_ + pool_bytes_ + o.size > capacity_ &&
+        !evict(used_ + pool_bytes_ + o.size - capacity_))
       return false;
     std::string path = spill_path_for(id);
     int dfd = open(path.c_str(), O_RDONLY);
@@ -333,6 +416,7 @@ class Store {
     if (!ok) return false;
     unlink(path.c_str());
     used_ += o.size;
+    o.capacity = o.size;  // restored into a fresh exact-size segment
     spilled_bytes_ -= o.size;
     o.state = SEALED;
     num_restores_++;
@@ -494,20 +578,23 @@ class Server {
       int n = snprintf(js, sizeof(js),
                        "{\"capacity\":%llu,\"used\":%llu,\"spilled\":%llu,"
                        "\"objects\":%zu,\"evictions\":%llu,\"spills\":%llu,"
-                       "\"restores\":%llu}",
+                       "\"restores\":%llu,\"pool_bytes\":%llu,"
+                       "\"recycles\":%llu}",
                        (unsigned long long)store_->capacity_,
                        (unsigned long long)store_->used_,
                        (unsigned long long)store_->spilled_bytes_,
                        store_->objects_.size(),
                        (unsigned long long)store_->num_evictions_,
                        (unsigned long long)store_->num_spills_,
-                       (unsigned long long)store_->num_restores_);
+                       (unsigned long long)store_->num_restores_,
+                       (unsigned long long)store_->pool_bytes_,
+                       (unsigned long long)store_->num_recycles_);
       return reply(fd, ST_OK, js, (uint32_t)n);
     }
     if (op == OP_LIST) {
       std::string out;
       for (auto& [id, o] : store_->objects_)
-        if (o.state != CREATED) out.append(id.b, 16);
+        if (o.state != CREATED && !o.pending_delete) out.append(id.b, 16);
       return reply(fd, ST_OK, out.data(), (uint32_t)out.size());
     }
     if (len < 17) return reply(fd, ST_ERR);
@@ -565,6 +652,65 @@ class Server {
         }
         return reply(fd, ST_OK, data.data(),
                      static_cast<uint32_t>(data.size()));
+      }
+      case OP_PUT_INLINE: {
+        // [op][id][payload...] -> create+copy+seal in one round trip: the
+        // dominant put shape is a small task result, where three store
+        // round trips (create/seal) plus client open/write/close syscalls
+        // cost more than the payload copy itself.
+        uint64_t size = len - 17;
+        Status st = store_->create(id, size, fd);
+        if (st == ST_EXISTS) return reply(fd, ST_EXISTS);
+        if (st != ST_OK) return reply(fd, st);
+        if (size) {
+          auto it = store_->objects_.find(id);
+          int sfd = shm_open(it->second.shm_name.c_str(), O_RDWR, 0);
+          if (sfd < 0) {
+            store_->del(id);
+            return reply(fd, ST_ERR);
+          }
+          void* m = mmap(nullptr, size, PROT_WRITE, MAP_SHARED, sfd, 0);
+          close(sfd);
+          if (m == MAP_FAILED) {
+            store_->del(id);
+            return reply(fd, ST_ERR);
+          }
+          memcpy(m, p + 17, size);
+          munmap(m, size);
+        }
+        store_->seal(id);
+        service_waiters();
+        return reply(fd, ST_OK);
+      }
+      case OP_GET_COPY_BATCH: {
+        // [op][pad:16][count:u32][max_inline:u64][16B x count] -> ST_OK +
+        // per-object [st:u8][size:u64][payload if st==OK]. One round trip
+        // for a whole ray_tpu.get() of task results.
+        if (len < 29) return reply(fd, ST_ERR);
+        uint32_t count;
+        uint64_t max_inline;
+        memcpy(&count, p + 17, 4);
+        memcpy(&max_inline, p + 21, 8);
+        if (len < 29 + (uint64_t)count * 16) return reply(fd, ST_ERR);
+        std::string out;
+        for (uint32_t k = 0; k < count; k++) {
+          ObjectId bid;
+          memcpy(bid.b, p + 29 + k * 16, 16);
+          uint64_t size = 0;
+          Status st = store_->get(bid, &size);
+          if (st == ST_OK && size > max_inline) st = ST_ERR;
+          out.push_back((char)st);
+          out.append((const char*)&size, 8);
+          if (st == ST_OK && size) {
+            size_t at = out.size();
+            out.resize(at + size);
+            if (!store_->read_into(bid, (uint8_t*)out.data() + at, size)) {
+              out.resize(at);
+              out[out.size() - 9] = (char)ST_NOT_FOUND;
+            }
+          }
+        }
+        return reply(fd, ST_OK, out.data(), (uint32_t)out.size());
       }
       case OP_RELEASE: {
         auto& refs = conns_[fd].refs;

@@ -26,6 +26,13 @@ class NodeProvider:
         cannot map (yet) return {} and opt out of termination."""
         return {}
 
+    def can_map(self, provider_id: str) -> bool:
+        """Whether node_id_map COULD ever map a cluster node to this
+        provider node. The zombie sweep must skip nodes the provider is
+        structurally blind to (e.g. a head/CPU VM in a TPU-only mapping) —
+        'unmapped' only means 'dead or never joined' for mappable ones."""
+        return True
+
 
 class FakeNodeProvider(NodeProvider):
     """In-process provider: "launching a node" starts a NodeDaemon thread
@@ -225,6 +232,11 @@ class StandardAutoscaler:
                           if nid in by_node_id}
             for pid, _t in workers:
                 if pid in registered:
+                    self._zombie_since.pop(pid, None)
+                elif not self.provider.can_map(pid):
+                    # The provider can never map this node (e.g. a head VM
+                    # in a TPU-slice-only mapping): unmapped is NOT a death
+                    # signal for it — terminating would kill a live VM.
                     self._zombie_since.pop(pid, None)
                 elif now - self._zombie_since.setdefault(pid, now) > \
                         self.zombie_grace_s:

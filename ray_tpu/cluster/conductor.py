@@ -560,12 +560,18 @@ class Conductor:
 
     def rpc_add_object_locations(self, oids: List[bytes],
                                  node_id: bytes) -> None:
-        """Bulk re-advertisement: a daemon that observes a new conductor
-        epoch replays its whole store inventory (the volatile half of
-        failover recovery; see persistence.py docstring)."""
+        """Bulk registration: a daemon replaying its store inventory after
+        a conductor epoch change (persistence.py), or a plane's batched
+        per-result registrations (object_plane._LocationBatcher). Same
+        tombstone semantics as the single-oid path: a copy sealed after
+        its refcount hit zero is a leak — delete it at the source."""
         with self._cv:
+            info = self._nodes.get(node_id)
+            addr = info["address"] if info and info["alive"] else None
             for oid in oids:
                 if oid in self._ref_tombstones:
+                    if addr is not None:
+                        self._enqueue_delete(addr, oid)
                     continue
                 self._object_locations[oid].add(node_id)
             self._cv.notify_all()
@@ -733,7 +739,9 @@ class Conductor:
             self._free_cv.notify()
 
     def _free_loop(self) -> None:
-        """Background deleter: store frees must not block RPC handlers."""
+        """Background deleter: store frees must not block RPC handlers.
+        Deletes are grouped per node into ONE batched RPC — churn of many
+        small objects must not become thousands of serial round trips."""
         while not self._stopped:
             with self._free_cv:
                 while not self._free_q and not self._stopped:
@@ -741,9 +749,12 @@ class Conductor:
                 batch = []
                 while self._free_q:
                     batch.append(self._free_q.popleft())
+            by_addr: Dict[str, List[bytes]] = {}
             for addr, oid in batch:
+                by_addr.setdefault(addr, []).append(oid)
+            for addr, oids in by_addr.items():
                 try:
-                    get_client(addr).call("delete_object", oid=oid)
+                    get_client(addr).call("delete_objects", oids=oids)
                 except Exception:
                     pass
 

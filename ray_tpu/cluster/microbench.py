@@ -33,6 +33,16 @@ def timed(fn, *, min_time: float = 1.0, min_iters: int = 3):
             return dt / n, n
 
 
+def settle(seconds: float = 1.0) -> None:
+    """Quiesce between op families: let the previous phase's GC backlog
+    (refcount flushes, batched deletes, pool refills) drain so each family
+    measures its own steady state, not the tail of its predecessor — the
+    reference's ray_perf.py likewise measures op families in isolation."""
+    import gc
+    gc.collect()
+    time.sleep(seconds)
+
+
 def main(argv=None) -> int:
     # CPU default only for the benchmark run itself (library importers of
     # this module must NOT have their jax platform silently forced).
@@ -50,7 +60,14 @@ def main(argv=None) -> int:
     scale = 0.2 if args.quick else 1.0
     results: dict = {}
 
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    # 1GB store: a realistic fraction of a TPU-host's RAM — the default
+    # 256MB can hold only two 100MB bandwidth-test objects, so the loop
+    # would measure spill I/O instead of the put path. 4 workers: enough
+    # parallelism for the async families without drowning a small host in
+    # context switches.
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 4,
+                                "object_store_bytes": 1 << 30})
     ray_tpu.init(address=c.address)
     try:
         # -- put/get small objects ------------------------------------
@@ -61,6 +78,7 @@ def main(argv=None) -> int:
         per, _ = timed(put_small, min_time=1.0 * scale)
         results["put_1kb_per_sec"] = round(100 / per, 1)
 
+        settle()
         ref = ray_tpu.put(b"y" * 1024)
 
         def get_small():
@@ -71,6 +89,7 @@ def main(argv=None) -> int:
         results["get_1kb_per_sec"] = round(100 / per, 1)
 
         # -- put/get bandwidth (100MB numpy, zero-copy shm path) ------
+        settle()
         big = np.zeros(100 * 1024 * 1024, dtype=np.uint8)
 
         def put_big():
@@ -80,6 +99,7 @@ def main(argv=None) -> int:
         results["put_get_100mb_gb_per_sec"] = round(0.1 / per, 2)
 
         # -- task submit+get roundtrip --------------------------------
+        settle()
         @ray_tpu.remote
         def nop():
             return None
@@ -100,6 +120,7 @@ def main(argv=None) -> int:
         results["tasks_async_per_sec"] = round(n_tasks / per, 1)
 
         # -- actor calls ----------------------------------------------
+        settle()
         @ray_tpu.remote
         class Counter:
             def __init__(self):
@@ -127,6 +148,7 @@ def main(argv=None) -> int:
         results["actor_calls_async_per_sec"] = round(n_calls / per, 1)
 
         # -- wait over many refs --------------------------------------
+        settle()
         refs = [ray_tpu.put(i) for i in range(1000)]
 
         def wait_1k():
@@ -136,6 +158,7 @@ def main(argv=None) -> int:
         results["wait_1k_refs_per_sec"] = round(1 / per, 2)
 
         # -- scheduler drain: queue 2k tasks at once ------------------
+        settle()
         n_q = int(2000 * scale) or 200
         t0 = time.perf_counter()
         ray_tpu.get([nop.remote() for _ in range(n_q)])

@@ -15,6 +15,7 @@ way the raylet colocates plasma (plasma/store_runner.cc).
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -31,8 +32,83 @@ from ray_tpu.cluster.protocol import RpcServer, get_client
 CHUNK_SIZE = 8 << 20  # object transfer chunk (reference uses 5MiB chunks)
 
 
+class _ForkedProc:
+    """Popen-compatible handle over a zygote-forked worker. The child's
+    PARENT is the zygote (which SIG_IGNs SIGCHLD so the kernel reaps —
+    no zombie pins the pid), so liveness is tracked through a pidfd: the
+    fd references THIS process, so a recycled pid can never masquerade as
+    the live worker. Falls back to signal-0 probing where pidfd is
+    unavailable."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._pidfd = -1
+        try:
+            self._pidfd = os.pidfd_open(pid)
+        except Exception:
+            # Already exited (reaped) or pidfd unsupported: distinguish by
+            # a direct probe below.
+            pass
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            if self._pidfd >= 0:
+                signal.pidfd_send_signal(self._pidfd, 0)
+            else:
+                os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            # Exit status unobservable (the kernel reaped the child);
+            # report generic nonzero.
+            self.returncode = 1
+            if self._pidfd >= 0:
+                os.close(self._pidfd)
+                self._pidfd = -1
+            return 1
+        except PermissionError:
+            return None
+
+    def kill(self) -> None:
+        try:
+            if self._pidfd >= 0:
+                signal.pidfd_send_signal(self._pidfd, signal.SIGKILL)
+            else:
+                os.kill(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+    # Popen-interface stubs used by supervisors.
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return 1
+
+    def terminate(self) -> None:
+        try:
+            if self._pidfd >= 0:
+                signal.pidfd_send_signal(self._pidfd, signal.SIGTERM)
+            else:
+                os.kill(self.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+    def __del__(self):
+        if self._pidfd >= 0:
+            try:
+                os.close(self._pidfd)
+            except OSError:
+                pass
+            self._pidfd = -1
+
+
 class _Worker:
-    def __init__(self, proc: subprocess.Popen, token: str, env_key: str):
+    def __init__(self, proc, token: str, env_key: str):
         self.proc = proc
         self.token = token
         self.env_key = env_key
@@ -108,6 +184,13 @@ class NodeDaemon:
         self._pending_demand: List[Dict[str, float]] = []
         self._pending_death_reports: List[dict] = []
         self._prestarting = 0
+        # Worker zygote (fork server): started lazily on the first
+        # default-env spawn; None until then, False after a failed start
+        # (permanent fallback to subprocess spawn).
+        self._zygote_proc = None
+        self._zygote_socket = os.path.join(
+            self.session_dir, f"zygote-{self.node_id.hex()[:8]}.sock")
+        self._zygote_lock = threading.Lock()
         self._infeasible_recent: Dict[tuple, float] = {}
         self._stopped = False
         self._jobs: Dict[str, dict] = {}   # submission_id -> {proc, log, ...}
@@ -130,6 +213,10 @@ class NodeDaemon:
         self._prestart_thread = threading.Thread(
             target=self._prestart_loop, daemon=True, name="daemon-prestart")
         self._prestart_thread.start()
+        # Pre-warm the fork server so the first worker/actor burst doesn't
+        # pay its ~0.3s import boot inline.
+        threading.Thread(target=self._ensure_zygote, daemon=True,
+                         name="zygote-warm").start()
         self._log_thread = threading.Thread(target=self._log_monitor_loop,
                                             daemon=True, name="daemon-logs")
         self._log_thread.start()
@@ -254,11 +341,100 @@ class NodeDaemon:
         from ray_tpu.runtime_env import env_fingerprint
         return env_fingerprint(runtime_env)
 
+    def _worker_base_env(self) -> Dict[str, str]:
+        """Env shared by every default-env worker (and the zygote).
+
+        Workers must not grab the TPU chip the trainer uses: plain task
+        workers run on CPU unless a lease/runtime_env says otherwise, and
+        CPU workers skip the TPU-plugin registration the image's
+        sitecustomize performs at interpreter start (it imports jax, ~2s
+        — spawn-to-register must stay well under the reaper's dead-worker
+        detection latency, worker_pool.h:156's prestart rationale)."""
+        env = dict(os.environ)
+        env.update(self._env_vars)
+        env.setdefault("JAX_PLATFORMS",
+                       env.get("RTPU_WORKER_JAX_PLATFORMS", "cpu"))
+        if env.get("JAX_PLATFORMS") == "cpu":
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        return env
+
+    def _ensure_zygote(self):
+        """Start (once) the fork server for default-env workers. Returns
+        the zygote Popen, or None when unavailable (fallback: subprocess
+        spawn). The zygote pays the ~0.25s worker-import cost once; each
+        subsequent worker is a fork (~15ms) — the difference between 3/s
+        and 25+/s actor creation on one host."""
+        with self._zygote_lock:
+            if self._zygote_proc is False:
+                return None
+            if self._zygote_proc is not None:
+                if self._zygote_proc.poll() is None:
+                    return self._zygote_proc
+                self._zygote_proc = None  # died; restart below
+            try:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu.cluster.worker_zygote",
+                     "--socket", self._zygote_socket],
+                    env=self._worker_base_env(),
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True)
+                line = proc.stdout.readline()
+                if not line.startswith("ZYGOTE_READY"):
+                    proc.kill()
+                    self._zygote_proc = False
+                    return None
+                self._zygote_proc = proc
+                return proc
+            except Exception:
+                self._zygote_proc = False
+                return None
+
+    def _fork_worker(self, argv: List[str], env: Dict[str, str],
+                     log_path: str) -> Optional[_ForkedProc]:
+        if self._ensure_zygote() is None:
+            return None
+        import json
+        import socket as _socket
+        try:
+            s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            s.settimeout(10.0)
+            s.connect(self._zygote_socket)
+            # Only the DELTA env rides the request (the zygote already runs
+            # under _worker_base_env); sending a full environ would mostly
+            # be noise but is harmless — the child applies it wholesale.
+            s.sendall(json.dumps({"argv": argv, "env": env, "cwd": None,
+                                  "log": log_path}).encode() + b"\n")
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = s.recv(4096)
+                if not chunk:
+                    return None
+                data += chunk
+            s.close()
+            return _ForkedProc(json.loads(data)["pid"])
+        except Exception:
+            return None
+
     def _spawn_worker(self, env_key: str,
                       runtime_env: Optional[dict]) -> _Worker:
         token = uuid.uuid4().hex
-        env = dict(os.environ)
-        env.update(self._env_vars)
+        if env_key == "" and not runtime_env:
+            # Default-env workers fork from the zygote when possible.
+            argv = ["--conductor", self.conductor_address,
+                    "--daemon", self.address,
+                    "--store-socket", self.store_socket,
+                    "--store-prefix", self.store_prefix,
+                    "--node-id", self.node_id.hex(),
+                    "--token", token]
+            log_path = os.path.join(self.session_dir,
+                                    f"worker-{token[:8]}.out")
+            proc = self._fork_worker(argv, {}, log_path)
+            if proc is not None:
+                w = _Worker(proc, token, env_key)
+                with self._lock:
+                    self._workers[token] = w
+                return w
+        env = self._worker_base_env()
         if runtime_env and runtime_env.get("env_vars"):
             env.update({str(k): str(v)
                         for k, v in runtime_env["env_vars"].items()})
@@ -273,18 +449,13 @@ class NodeDaemon:
                 prev = env.get("PYTHONPATH", "")
                 env["PYTHONPATH"] = (extra + os.pathsep + prev) if prev \
                     else extra
-        # Worker subprocesses must not grab the TPU chip the trainer uses;
-        # plain task workers run on CPU unless the lease says otherwise.
-        env.setdefault("JAX_PLATFORMS", env.get("RTPU_WORKER_JAX_PLATFORMS",
-                                                "cpu"))
-        if env.get("JAX_PLATFORMS") == "cpu":
-            # CPU-only workers skip the TPU-plugin registration the image's
-            # sitecustomize performs at interpreter start (it imports jax,
-            # ~2s): spawn-to-register must stay well under the node reaper's
-            # dead-worker detection latency for lease grants to beat worker
-            # churn (worker_pool.h:156's prestart exists for the same
-            # reason).
-            env.pop("PALLAS_AXON_POOL_IPS", None)
+        # _worker_base_env defaulted JAX_PLATFORMS=cpu and dropped the TPU
+        # plugin registration; a runtime_env that explicitly requests a
+        # non-CPU platform gets the registration back.
+        if env.get("JAX_PLATFORMS") != "cpu" and \
+                "PALLAS_AXON_POOL_IPS" in os.environ:
+            env.setdefault("PALLAS_AXON_POOL_IPS",
+                           os.environ["PALLAS_AXON_POOL_IPS"])
         cwd = None
         if runtime_env and runtime_env.get("working_dir"):
             cwd = runtime_env["working_dir"]
@@ -460,12 +631,15 @@ class NodeDaemon:
             # so a fresh push or pull can recreate the entry.
             with self._push_lock:
                 now = time.monotonic()
-                stale = [o for o, st in self._push_partial.items()
+                stale = [(o, st) for o, st in self._push_partial.items()
                          if now - st["ts"] > 30.0]
-                for oid in stale:
+                for oid, _ in stale:
                     self._push_partial.pop(oid, None)
-            for oid in stale:  # store I/O outside the lock
+            for oid, st in stale:  # store I/O outside the push-dict lock
                 try:
+                    with st["lock"]:   # never close under a mid-flight
+                        if st["buf"] is not None:  # chunk write
+                            st["buf"].close()
                     self.store.delete(oid)
                 except Exception:
                     pass
@@ -859,7 +1033,7 @@ class NodeDaemon:
                         self._push_partial.pop(oid, None)
                     return {"done": True}
                 try:
-                    st["buf"] = self.store.create(oid, total)
+                    st["buf"] = self.store.create_writer(oid, total)
                 except Exception:
                     with self._push_lock:
                         self._push_partial.pop(oid, None)
@@ -878,18 +1052,20 @@ class NodeDaemon:
                 # sealed).
                 with self._push_lock:
                     self._push_partial.pop(oid, None)
+                st["buf"].close()
                 try:
                     self.store.delete(oid)
                 except Exception:
                     pass
                 return {"reject": True}
-            st["buf"][offset:offset + len(chunk)] = chunk
+            st["buf"].write_at(offset, chunk)
             st["off"] += len(chunk)
             st["ts"] = time.monotonic()
             if st["off"] < total:
                 return {"ok": True}
             with self._push_lock:
                 self._push_partial.pop(oid, None)
+            st["buf"].close()
         try:
             self.store.seal(oid)
         except Exception:
@@ -910,6 +1086,16 @@ class NodeDaemon:
             self.store.delete(oid)
         except Exception:
             pass
+
+    def rpc_delete_objects(self, oids: List[bytes]) -> None:
+        """Batched GC deletes (the conductor's free loop coalesces — a
+        small-object churn otherwise turns into thousands of serial
+        single-delete RPCs that monopolize the store's event loop)."""
+        for oid in oids:
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass
 
     def rpc_store_stats(self) -> dict:
         return self.store.stats()
@@ -1083,6 +1269,13 @@ class NodeDaemon:
         for w in workers:
             try:
                 w.proc.kill()
+            except OSError:
+                pass
+        with self._zygote_lock:
+            z, self._zygote_proc = self._zygote_proc, False
+        if z not in (None, False):
+            try:
+                z.kill()
             except OSError:
                 pass
         self.server.stop()

@@ -21,6 +21,9 @@ from ray_tpu.core import serialization
 from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
 from ray_tpu.core.ids import ObjectID, store_key
 
+# Batch-get miss marker (a stored value may legitimately be None).
+MISS = object()
+
 
 class _ByteBudget:
     """Admission control for concurrent pulls (pull_manager.h:52 role):
@@ -45,6 +48,82 @@ class _ByteBudget:
             self._cv.notify_all()
 
 
+class _LocationBatcher:
+    """Coalesces add_object_location registrations into one conductor RPC
+    per ~2ms burst window. A task-result-heavy worker was spending a
+    synchronous conductor round trip PER RESULT — at thousands of results/s
+    that RPC dominates completion throughput. Registration becomes eventual
+    (bounded by the flush window): same-node readers never notice (they hit
+    the local store directly) and cross-node readers long-poll the
+    directory anyway."""
+
+    _WINDOW_S = 0.002
+
+    def __init__(self, conductor, node_id: bytes):
+        self._conductor = conductor
+        self._node_id = node_id
+        self._buf: list = []
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="loc-batch")
+        self._thread.start()
+
+    _MAX_BUFFER = 262_144  # registrations kept across a conductor outage
+
+    def add(self, key: bytes) -> None:
+        with self._lock:
+            self._buf.append(key)
+        self._event.set()
+
+    def _loop(self) -> None:
+        backoff = self._WINDOW_S
+        while not self._stopped:
+            # Event-driven: block until the FIRST add (zero idle wakeups —
+            # a polling loop here costs real throughput on small hosts),
+            # then sleep one short window so followers coalesce.
+            self._event.wait()
+            if self._stopped:
+                return
+            time.sleep(backoff)
+            self._event.clear()
+            with self._lock:
+                batch, self._buf = self._buf, []
+            if not batch:
+                continue
+            try:
+                self._conductor.call("add_object_locations", oids=batch,
+                                     node_id=self._node_id)
+                backoff = self._WINDOW_S
+            except Exception:
+                # Conductor unreachable (failover window): back off up to
+                # 1s instead of hammering at the burst cadence, and bound
+                # the buffer — after reconnection the daemon re-advertises
+                # its whole store inventory anyway, so dropped entries are
+                # recovered by that replay.
+                backoff = min(backoff * 4, 1.0)
+                with self._lock:
+                    self._buf = (batch + self._buf)[-self._MAX_BUFFER:]
+                self._event.set()
+
+    def flush(self) -> None:
+        """Synchronous drain (shutdown; tests)."""
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if batch:
+            try:
+                self._conductor.call("add_object_locations", oids=batch,
+                                     node_id=self._node_id)
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._event.set()
+        self.flush()
+
+
 class ObjectPlane:
     def __init__(self, store: object_client.ShmClient, node_id: bytes,
                  conductor_address: str):
@@ -58,6 +137,7 @@ class ObjectPlane:
         self._pull_guard = threading.Lock()
         self._pull_budget = _ByteBudget(
             config.get("max_concurrent_pull_bytes"))
+        self._loc_batcher = _LocationBatcher(self.conductor, node_id)
 
     # -- write ----------------------------------------------------------
     def put_value(self, oid: ObjectID, value: Any) -> int:
@@ -73,32 +153,42 @@ class ObjectPlane:
             if t is not None:
                 t.add_children(key, [store_key(r.id.binary()) for r in refs])
         try:
-            buf = self.store.create(key, total)
-            off = 0
-            for seg in segments:
-                m = memoryview(seg)
-                buf[off:off + m.nbytes] = m
-                off += m.nbytes
-            self.store.seal(key)
+            if total <= 64 << 10:
+                # One store round trip (vs create+seal, plus the client's
+                # open/pwrite/close) — task results are overwhelmingly
+                # this shape.
+                blob = segments[0] if len(segments) == 1 else \
+                    b"".join(bytes(memoryview(s).cast("B"))
+                             for s in segments)
+                self.store.put_inline(key, blob)
+            else:
+                w = self.store.create_writer(key, total)
+                try:
+                    off = 0
+                    for seg in segments:
+                        off += w.write_at(off, seg)
+                finally:
+                    w.close()
+                self.store.seal(key)
         except object_client.ObjectStoreError as e:
             if "already exists" not in str(e):
                 raise
-        self.conductor.call("add_object_location", oid=key,
-                            node_id=self.node_id)
+        self._loc_batcher.add(key)
         return total
 
     def put_blob(self, oid: ObjectID, blob: bytes) -> int:
         key = self._key(oid)
         try:
-            buf = self.store.create(key, len(blob))
-            if len(blob):
-                buf[:] = blob
+            w = self.store.create_writer(key, len(blob))
+            try:
+                w.write_at(0, blob)
+            finally:
+                w.close()
             self.store.seal(key)
         except object_client.ObjectStoreError as e:
             if "already exists" not in str(e):
                 raise
-        self.conductor.call("add_object_location", oid=key,
-                            node_id=self.node_id)
+        self._loc_batcher.add(key)
         return len(blob)
 
     # -- read -----------------------------------------------------------
@@ -115,6 +205,15 @@ class ObjectPlane:
             # readers fall back to the object directory / recovery.
             return False
 
+    def get_values_local_inline(self, oids: List[ObjectID]) -> List[Any]:
+        """Batch fast path for ray_tpu.get() over many refs: ONE store
+        round trip resolves every LOCAL sealed small object; misses come
+        back as the MISS sentinel (a stored value may legitimately be
+        None) and take the per-object path (remote / large / unsealed)."""
+        blobs = self.store.get_inline_batch([self._key(o) for o in oids])
+        return [MISS if b is None else
+                serialization.deserialize(memoryview(b)) for b in blobs]
+
     def get_value(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
         # Small sealed LOCAL objects come back inline in ONE store round
         # trip (no get+release pair, no mmap) — the dominant pattern when
@@ -124,16 +223,19 @@ class ObjectPlane:
             return serialization.deserialize(memoryview(data))
         view = self.get_view(oid, timeout=timeout)
         value = serialization.deserialize(view)
-        # NOTE: buffer-backed values (numpy arrays) stay zero-copy views over
-        # the shm mapping; the mapping outlives release() (mmap semantics).
-        self.store.release(self._key(oid))
+        # Buffer-backed values (numpy arrays) stay zero-copy views over the
+        # shm mapping; the PINNED ref (get_view -> get_pinned) keeps the
+        # object alive in the store until those views are GC'd, so the
+        # daemon can never recycle pages under a live array.
         return value
 
     def get_view(self, oid: ObjectID,
                  timeout: Optional[float] = None) -> memoryview:
+        """Zero-copy view, pinned: the store ref drops when the view (and
+        every value deserialized over it) is garbage collected."""
         key = self._key(oid)
         # Fast path: local.
-        view = self.store.get(key, timeout=0.0)
+        view = self.store.get_pinned(key, timeout=0.0)
         if view is not None:
             return view
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -144,14 +246,14 @@ class ObjectPlane:
                     f"timed out waiting for object {oid.hex()}")
             loc = self.conductor.call("locate_object", oid=key,
                                       timeout=min(remaining, 2.0))
-            view = self.store.get(key, timeout=0.0)
+            view = self.store.get_pinned(key, timeout=0.0)
             if view is not None:
                 return view
             for node in loc["nodes"]:
                 if node["node_id"] == self.node_id:
                     continue
                 if self._pull(key, node["address"]):
-                    view = self.store.get(key, timeout=0.0)
+                    view = self.store.get_pinned(key, timeout=0.0)
                     if view is not None:
                         return view
             # No location known yet (still being computed) -> loop.
@@ -175,13 +277,16 @@ class ObjectPlane:
                 size = info["size"]
                 self._pull_budget.acquire(size)
                 admitted = size
-                buf = self.store.create(key, size)
-                off = 0
-                while off < size:
-                    n = min(CHUNK_SIZE, size - off)
-                    chunk = cli.call("fetch_chunk", oid=key, offset=off, size=n)
-                    buf[off:off + n] = chunk
-                    off += n
+                w = self.store.create_writer(key, size)
+                try:
+                    off = 0
+                    while off < size:
+                        n = min(CHUNK_SIZE, size - off)
+                        chunk = cli.call("fetch_chunk", oid=key,
+                                         offset=off, size=n)
+                        off += w.write_at(off, chunk)
+                finally:
+                    w.close()
                 self.store.seal(key)
             except object_client.ObjectStoreError as e:
                 if "already exists" in str(e):
@@ -192,9 +297,11 @@ class ObjectPlane:
             finally:
                 if admitted:
                     self._pull_budget.release(admitted)
-            self.conductor.call("add_object_location", oid=key,
-                                node_id=self.node_id)
+            self._loc_batcher.add(key)
             return True
 
     def free(self, oid: ObjectID) -> None:
         self.conductor.call("free_object", oid=self._key(oid))
+
+    def stop(self) -> None:
+        self._loc_batcher.stop()

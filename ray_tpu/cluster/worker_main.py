@@ -98,6 +98,23 @@ class WorkerService:
         # released on kill/exit so a dead actor doesn't leak its arguments.
         self._taken_pins: Dict[bytes, int] = {}
         self._shutdown = threading.Event()
+        # Orphan watchdog: a worker whose NODE DAEMON is gone (daemon
+        # process SIGKILLed, chaos test, host teardown race) must exit
+        # rather than linger — an orphan herd's doomed reconnect loops
+        # measurably tax the host, and nothing will ever lease it again.
+        threading.Thread(target=self._daemon_watchdog, daemon=True,
+                         name="daemon-watchdog").start()
+
+    def _daemon_watchdog(self) -> None:
+        misses = 0
+        while not self._shutdown.wait(5.0):
+            try:
+                get_client(self.daemon_address).call("ping", _timeout=5.0)
+                misses = 0
+            except Exception:
+                misses += 1
+                if misses >= 3:
+                    os._exit(1)
 
     # ------------------------------------------------------------------
     def _load_fn(self, function_id: str, blob: Optional[bytes]):
@@ -433,22 +450,38 @@ def main() -> None:
     ap.add_argument("--node-id", required=True)
     ap.add_argument("--token", required=True)
     args = ap.parse_args()
+    prof = os.environ.get("RTPU_WORKER_STARTUP_PROF")
+    marks = [("start", time.perf_counter())]
     node_id = bytes.fromhex(args.node_id)
     svc = WorkerService(args.conductor, args.daemon, args.store_socket,
                         args.store_prefix, node_id)
+    marks.append(("service", time.perf_counter()))
     server = RpcServer(svc)
     svc.address = server.address
+    marks.append(("rpc_server", time.perf_counter()))
     # Connect the in-process public API so user code can submit nested work.
     from ray_tpu.core import api
     from ray_tpu.core.runtime_cluster import ClusterRuntime
+    marks.append(("runtime_import", time.perf_counter()))
     api._runtime = ClusterRuntime.for_worker(
         conductor_address=args.conductor, daemon_address=args.daemon,
         store=svc.store, plane=svc.plane, node_id=node_id)
+    marks.append(("for_worker", time.perf_counter()))
     get_client(args.daemon).call(
         "register_worker", token=args.token,
         worker_id=svc.worker_id.binary(), address=server.address,
         pid=os.getpid())
+    marks.append(("registered", time.perf_counter()))
+    if prof:
+        base = marks[0][1]
+        print("STARTUP " + " ".join(
+            f"{k}={1000 * (ts - base):.1f}ms" for k, ts in marks[1:]),
+            flush=True)
     svc._shutdown.wait()
+    try:
+        svc.plane.stop()   # drain batched location registrations
+    except Exception:
+        pass
 
 
 if __name__ == "__main__":

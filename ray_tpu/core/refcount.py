@@ -27,11 +27,17 @@ always reaches the conductor before the submitter's balancing -1.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu.core.ids import store_key
 
-_FLUSH_INTERVAL_S = 0.05
+# Coalescing window between the first buffered event and the flush RPC
+# (the flusher is otherwise parked — no idle wakeups). Kept short because
+# garbage lag bounds how fast the store's segment-recycle pool refills
+# under put-heavy loops: a 100MB put is ~10ms, so a 50ms lag would leave
+# every iteration allocating fresh zero-fill pages and pressure-evicting.
+_FLUSH_INTERVAL_S = 0.005
 _FLUSH_BATCH = 2000
 
 
@@ -55,22 +61,27 @@ class RefTracker:
                                         name="ref-flush")
         self._thread.start()
 
+    def _append_event(self, ev) -> None:
+        """Caller holds self._lock. Wakes the parked flusher on the FIRST
+        buffered event (it coalesces a burst before shipping)."""
+        self._events.append(ev)
+        if len(self._events) == 1 or len(self._events) >= _FLUSH_BATCH:
+            self._cv.notify()
+
     # -- handle lifecycle (called from ObjectRef __init__/__del__) ------
     def handle_created(self, oid: bytes) -> None:
         with self._cv:
             c = self._local.get(oid, 0)
             self._local[oid] = c + 1
             if c == 0:
-                self._events.append((store_key(oid), 1))
-                if len(self._events) >= _FLUSH_BATCH:
-                    self._cv.notify()
+                self._append_event((store_key(oid), 1))
 
     def handle_dropped(self, oid: bytes) -> None:
         with self._cv:
             c = self._local.get(oid, 0) - 1
             if c <= 0:
                 self._local.pop(oid, None)
-                self._events.append((store_key(oid), -1))
+                self._append_event((store_key(oid), -1))
             else:
                 self._local[oid] = c
 
@@ -90,7 +101,7 @@ class RefTracker:
         with self._lock:
             for k in keys:
                 self._pins[k] = self._pins.get(k, 0) + 1
-                self._events.append((k, 1))
+                self._append_event((k, 1))
         if flush:
             self.flush()
 
@@ -102,7 +113,7 @@ class RefTracker:
                     self._pins.pop(k, None)
                 else:
                     self._pins[k] = c
-                self._events.append((k, -1))
+                self._append_event((k, -1))
 
     def add_children(self, parent_key: bytes, child_keys: List[bytes],
                      flush: bool = True) -> None:
@@ -112,7 +123,7 @@ class RefTracker:
         otherwise deserialize + drop child handles whose net-zero event
         pair outruns this registration)."""
         with self._lock:
-            self._events.append((parent_key, list(child_keys)))
+            self._append_event((parent_key, list(child_keys)))
         if flush:
             self.flush()
 
@@ -207,9 +218,15 @@ class RefTracker:
     def _loop(self) -> None:
         while True:
             with self._cv:
+                # Event-driven: park until the FIRST buffered event (no
+                # idle wakeups — N processes polling at the flush interval
+                # measurably tax a small host), then sleep one interval so
+                # a burst coalesces into a single RPC.
+                while not self._events and not self._stopped:
+                    self._cv.wait()
                 if self._stopped and not self._events:
                     return
-                self._cv.wait(_FLUSH_INTERVAL_S)
+            time.sleep(_FLUSH_INTERVAL_S)
             self.flush()
 
     def stop(self) -> None:

@@ -45,7 +45,7 @@ from ray_tpu.runtime_env import env_fingerprint as _env_fingerprint
 
 _LEASE_LINGER_S = 0.25     # idle lease kept briefly for reuse
 _MAX_LEASES_PER_KEY = 64
-_PUSH_BATCH = 8            # tasks coalesced per push RPC when queues are deep
+_PUSH_BATCH = 32           # tasks coalesced per push RPC when queues are deep
 
 
 class _LeasedWorker:
@@ -86,6 +86,16 @@ class _TaskRecord:
 
     def nbytes(self) -> int:
         return len(self.task.get("args_blob") or b"")
+
+
+class _GetFailure:
+    """Slot marker for a per-ref get() failure; the first one (submission
+    order) is re-raised after every slot settles."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
 
 
 class TaskSubmitter:
@@ -909,33 +919,43 @@ class ClusterRuntime:
 
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
+        from ray_tpu.cluster.object_plane import MISS
         deadline = None if timeout is None else time.monotonic() + timeout
         if len(refs) > 4:
             self._prewait(refs, deadline)
         if len(refs) <= 1:
             return [self._get_one(ref, deadline) for ref in refs]
-        # Resolve concurrently: N remote objects fetch in parallel (the
-        # reference's Get batches plasma fetches the same way) and a lost
-        # object's recovery clock starts immediately instead of after its
-        # predecessors resolve.
-        with ThreadPoolExecutor(
-                max_workers=min(16, len(refs)),
-                thread_name_prefix="get") as pool:
-            futs = [pool.submit(self._get_one, ref, deadline) for ref in refs]
-            # Surface the first error in submission order (reference
-            # behavior), but let every future settle first so the pool
-            # doesn't leak workers into shutdown.
-            results, first_exc = [], None
-            for f in futs:
-                try:
-                    results.append(f.result())
-                except BaseException as e:  # noqa: BLE001 - re-raised below
-                    if first_exc is None:
-                        first_exc = e
-                    results.append(None)
-            if first_exc is not None:
-                raise first_exc
-            return results
+        # Batch fast path: one store round trip resolves every LOCAL
+        # sealed small object (the dominant shape — a get() over many task
+        # results). Misses fall through to the concurrent per-object path.
+        try:
+            results = self.plane.get_values_local_inline(
+                [r.id for r in refs])
+        except Exception:
+            results = [MISS] * len(refs)
+        missing = [i for i, v in enumerate(results) if v is MISS]
+        if missing:
+            # Resolve concurrently: N remote objects fetch in parallel (the
+            # reference's Get batches plasma fetches the same way) and a
+            # lost object's recovery clock starts immediately instead of
+            # after its predecessors resolve.
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(missing)),
+                    thread_name_prefix="get") as pool:
+                futs = {i: pool.submit(self._get_one, refs[i], deadline)
+                        for i in missing}
+                for i, f in futs.items():
+                    try:
+                        results[i] = f.result()
+                    except BaseException as e:  # noqa: BLE001
+                        results[i] = _GetFailure(e)
+        # Surface the first error in submission order (reference behavior).
+        for i, v in enumerate(results):
+            if isinstance(v, _GetFailure):
+                raise v.exc
+            if isinstance(v, TaskError):
+                raise v
+        return results
 
     def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         waited = 0.0
@@ -1302,6 +1322,10 @@ class ClusterRuntime:
             _refs_mod._tracker = None
         try:
             self._ref_tracker.stop()
+        except Exception:
+            pass
+        try:
+            self.plane.stop()   # drain batched location registrations
         except Exception:
             pass
         if self._owned_daemon is not None:

@@ -59,11 +59,20 @@ class _JaxArrayPlaceholder:
     @classmethod
     def jax_array_types(cls):
         if cls._types is None:
+            # NEVER import jax here: a value can only BE a jax array if
+            # jax is already in sys.modules, and importing it costs ~1.5s
+            # CPU + hundreds of MB in every worker that pickles its first
+            # numpy array (measured as a mystery 1.5s first-put stall).
+            import sys
+            jax = sys.modules.get("jax")
+            if jax is None:
+                return ()   # don't cache — jax may be imported later
             try:
-                import jax
                 cls._types = (jax.Array,)
-            except Exception:  # pragma: no cover
-                cls._types = ()
+            except Exception:
+                # jax is mid-import on another thread (module present but
+                # not fully initialized): don't poison the cache.
+                return ()
         return cls._types
 
 
