@@ -378,7 +378,12 @@ class NodeDaemon:
                     env=self._worker_base_env(),
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                     text=True)
-                line = proc.stdout.readline()
+                # Bounded handshake: a zygote hung in its pre-imports must
+                # not wedge every _spawn_worker behind _zygote_lock — time
+                # out, kill it, and fall back to subprocess spawn forever.
+                import select
+                ready, _, _ = select.select([proc.stdout], [], [], 60.0)
+                line = proc.stdout.readline() if ready else ""
                 if not line.startswith("ZYGOTE_READY"):
                     proc.kill()
                     self._zygote_proc = False
@@ -451,11 +456,13 @@ class NodeDaemon:
                     else extra
         # _worker_base_env defaulted JAX_PLATFORMS=cpu and dropped the TPU
         # plugin registration; a runtime_env that explicitly requests a
-        # non-CPU platform gets the registration back.
-        if env.get("JAX_PLATFORMS") != "cpu" and \
-                "PALLAS_AXON_POOL_IPS" in os.environ:
-            env.setdefault("PALLAS_AXON_POOL_IPS",
-                           os.environ["PALLAS_AXON_POOL_IPS"])
+        # non-CPU platform gets the registration back (from the daemon's
+        # configured env first — it overrides the inherited environ in
+        # _worker_base_env too).
+        pool_ips = self._env_vars.get("PALLAS_AXON_POOL_IPS") or \
+            os.environ.get("PALLAS_AXON_POOL_IPS")
+        if env.get("JAX_PLATFORMS") != "cpu" and pool_ips:
+            env.setdefault("PALLAS_AXON_POOL_IPS", pool_ips)
         cwd = None
         if runtime_env and runtime_env.get("working_dir"):
             cwd = runtime_env["working_dir"]

@@ -117,6 +117,23 @@ class _MapCache:
                 return ent[1]
         return None
 
+    def _sweep_unlinked_locked(self) -> None:
+        """Drop entries whose inode the store already unlinked (evicted
+        pool segment): st_nlink==0 means OUR fd+mmap are the only thing
+        keeping those tmpfs pages resident — memory the store believes it
+        freed. Caller holds the lock; a handful of fstats."""
+        for key in list(self._entries):
+            kfd, _kmm, ksize = self._entries[key]
+            try:
+                alive = os.fstat(kfd).st_nlink > 0
+            except OSError:
+                alive = False
+            if not alive:
+                self._order.remove(key)
+                kfd, _kmm, ksize = self._entries.pop(key)
+                self._bytes -= ksize
+                os.close(kfd)  # mmap ref dropped; GC unmaps when unused
+
     def insert(self, fd: int, size: int) -> None:
         """Map (unfaulted; faults resolve on first cached write) and keep a
         dup'd fd so the inode stays pinned."""
@@ -125,6 +142,7 @@ class _MapCache:
         st = os.fstat(fd)
         key = (st.st_dev, st.st_ino)
         with self._lock:
+            self._sweep_unlinked_locked()
             if key in self._entries:
                 return
             keep = os.dup(fd)
@@ -210,8 +228,43 @@ class ShmClient:
         # next store call under the socket lock. A finalizer must never
         # touch the socket itself — it can fire mid-_call on this very
         # thread (GC during allocation) and would deadlock or corrupt the
-        # frame stream.
+        # frame stream. A background drain covers the idle case: a process
+        # that stops calling the store must still drop its pins, or the
+        # daemon can never delete/evict those objects (deferred-delete +
+        # recycling both key off refcount 0).
         self._deferred_releases: "deque[bytes]" = deque()
+        self._closed = False
+        threading.Thread(target=self._release_drain_loop, daemon=True,
+                         name="shm-release-drain").start()
+
+    def _queue_release(self, oid: bytes) -> None:
+        # Append ONLY — a finalizer may fire inside any lock/Event
+        # critical section on this very thread; deque.append is the one
+        # operation that is safe everywhere.
+        self._deferred_releases.append(oid)
+
+    def _release_drain_loop(self) -> None:
+        # 1Hz poll (not event-driven: finalizers can't safely signal an
+        # Event). Cheap — one wakeup/sec/client, and _call() drains
+        # eagerly in active processes anyway.
+        while not self._closed:
+            time.sleep(1.0)
+            if self._closed:
+                return
+            if not self._deferred_releases:
+                continue
+            try:
+                self._drain_releases()
+            except Exception:
+                return  # socket gone; the daemon reaps on disconnect
+
+    def _drain_releases(self) -> None:
+        with self._lock:
+            while self._deferred_releases:
+                oid = self._deferred_releases.popleft()
+                self._sock.sendall(struct.pack(
+                    "<IB16s", 17, OP_RELEASE, oid))
+                self._read_frame()
 
     # --- framing ---------------------------------------------------------
     def _call(self, payload: bytes) -> bytes:
@@ -311,9 +364,9 @@ class ShmClient:
         mm, _size = got
         if mm is None:
             # Zero-byte objects have no mapping to pin; drop the ref now.
-            self._deferred_releases.append(bytes(oid))
+            self._queue_release(bytes(oid))
             return memoryview(b"")
-        weakref.finalize(mm, self._deferred_releases.append, bytes(oid))
+        weakref.finalize(mm, self._queue_release, bytes(oid))
         return memoryview(mm)
 
     def _get_map(self, oid: bytes, timeout: Optional[float]):
@@ -426,6 +479,7 @@ class ShmClient:
         return [bytes(body[i:i + 16]) for i in range(0, len(body), 16)]
 
     def close(self) -> None:
+        self._closed = True
         try:
             self._sock.close()
         except OSError:

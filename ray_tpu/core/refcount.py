@@ -221,12 +221,18 @@ class RefTracker:
                 # Event-driven: park until the FIRST buffered event (no
                 # idle wakeups — N processes polling at the flush interval
                 # measurably tax a small host), then sleep one interval so
-                # a burst coalesces into a single RPC.
-                while not self._events and not self._stopped:
+                # a burst coalesces into a single RPC. A failed batch
+                # (_pending_batch) must keep retrying on a timer though —
+                # parking would strand its -1 deltas until some unrelated
+                # ref event happened to arrive.
+                while not self._events and not self._stopped and \
+                        self._pending_batch is None:
                     self._cv.wait()
                 if self._stopped and not self._events:
                     return
-            time.sleep(_FLUSH_INTERVAL_S)
+                retrying = self._pending_batch is not None and \
+                    not self._events
+            time.sleep(0.5 if retrying else _FLUSH_INTERVAL_S)
             self.flush()
 
     def stop(self) -> None:

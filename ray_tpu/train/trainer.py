@@ -27,6 +27,25 @@ from ray_tpu.train.backend_executor import (Backend, BackendExecutor,
                                             JaxBackend, TrainingFailedError)
 
 
+_CKPT_MARKER = "_COMPLETE"
+
+
+def _find_restorable_checkpoint(trial_dir: str) -> Optional[str]:
+    """Newest COMPLETE persisted checkpoint, surviving a crash at any
+    point of _persist_checkpoint's swap: prefer checkpoint_latest, then
+    .tmp (newer but unswapped — complete iff marked), then .old."""
+    final = os.path.join(trial_dir, "checkpoint_latest")
+    for cand in (final, final + ".tmp", final + ".old"):
+        if os.path.isdir(cand) and \
+                os.path.exists(os.path.join(cand, _CKPT_MARKER)):
+            return cand
+    # Pre-marker layouts (or externally written dirs): accept a bare
+    # checkpoint_latest rather than silently restarting from scratch.
+    if os.path.isdir(final):
+        return final
+    return None
+
+
 class BaseTrainer:
     def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
@@ -37,6 +56,64 @@ class BaseTrainer:
 
     def fit(self) -> Result:
         raise NotImplementedError
+
+    @classmethod
+    def restore(cls, path: str) -> "BaseTrainer":
+        """Rebuild a trainer from a previous run's trial dir and resume
+        from its latest persisted checkpoint (parity:
+        base_trainer.py:567-579 BaseTrainer.restore — experiment-level
+        resume after DRIVER death, vs. the in-fit elastic restart that
+        only survives worker death)."""
+        from ray_tpu.core import serialization
+        spec_path = os.path.join(path, "trainer.pkl")
+        if not os.path.exists(spec_path):
+            raise FileNotFoundError(
+                f"no trainer state found under {path!r} (trainer.pkl "
+                "missing — was fit() ever started here?)")
+        with open(spec_path, "rb") as f:
+            trainer = serialization.loads(f.read())
+        ckpt_dir = _find_restorable_checkpoint(path)
+        if ckpt_dir is not None:
+            trainer.resume_from_checkpoint = Checkpoint.from_directory(
+                ckpt_dir)
+        return trainer
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "trainer.pkl"))
+
+    def _save_spec(self, trial_dir: str) -> None:
+        """Persist this trainer's construction so restore() can rebuild it
+        in a fresh process (written once, before training starts)."""
+        from ray_tpu.core import serialization
+        spec_path = os.path.join(trial_dir, "trainer.pkl")
+        if not os.path.exists(spec_path):
+            tmp = spec_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(serialization.dumps(self))
+            os.replace(tmp, spec_path)
+
+    @staticmethod
+    def _persist_checkpoint(trial_dir: str, ckpt: Checkpoint) -> None:
+        """Write the latest checkpoint under the trial dir so a dead
+        driver can resume from disk, not just from memory. Directory swaps
+        cannot be single-rename-atomic; every intermediate state is
+        covered by a COMPLETE marker + the restore fallback chain
+        (_find_restorable_checkpoint): .tmp carries the marker only once
+        fully written, .old keeps the previous complete checkpoint until
+        the new one is in place."""
+        final = os.path.join(trial_dir, "checkpoint_latest")
+        tmp, old = final + ".tmp", final + ".old"
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        ckpt.to_directory(tmp)
+        with open(os.path.join(tmp, _CKPT_MARKER), "w") as f:
+            f.write("1")
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.isdir(final):
+            os.replace(final, old)
+        os.replace(tmp, final)
+        shutil.rmtree(old, ignore_errors=True)
 
     def as_trainable(self) -> Callable[[dict], Result]:
         """A Tune-compatible trainable closing over this trainer (parity:
@@ -82,6 +159,7 @@ class DataParallelTrainer(BaseTrainer):
             cfg.storage_path or tempfile.gettempdir(),
             cfg.name or "rtpu_train")
         os.makedirs(trial_dir, exist_ok=True)
+        self._save_spec(trial_dir)
         stop = cfg.stop or {}
         failure = cfg.failure_config or FailureConfig()
         attempts = 0
@@ -100,6 +178,12 @@ class DataParallelTrainer(BaseTrainer):
                 state["history"].append(merged["metrics"])
                 if merged["checkpoint"] is not None:
                     state["last_checkpoint"] = merged["checkpoint"]
+                    try:
+                        self._persist_checkpoint(trial_dir,
+                                                 merged["checkpoint"])
+                    except Exception:
+                        pass  # persistence is best-effort; in-memory
+                        # state still drives this fit()'s own restarts
                 for key, bound in stop.items():
                     if key == "training_iteration":
                         if merged["iteration"] >= bound:

@@ -171,6 +171,67 @@ class ResultGrid:
             return rows
 
 
+class _ExperimentLedger:
+    """Append-only experiment journal under the experiment dir.
+
+    Parity role: tune's experiment checkpointing (trial_runner state +
+    checkpoint_manager) — enough durable truth that ``Tuner.restore`` in a
+    FRESH process can skip completed trials and re-run only unfinished
+    ones. Records are sequential pickles ("suggest" when a trial's config
+    is fixed, "complete" when it finishes); a torn tail write (driver
+    died mid-append) is ignored on load. Completed trials additionally
+    persist their full Result payload to <trial_id>/result.pkl so metrics
+    AND checkpoints survive the driver."""
+
+    STATE = "experiment_state.pkls"
+
+    def __init__(self, exp_dir: str):
+        self.exp_dir = exp_dir
+        self._path = os.path.join(exp_dir, self.STATE)
+
+    def append(self, record: dict) -> None:
+        import pickle
+        with open(self._path, "ab") as f:
+            pickle.dump(record, f, protocol=5)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def load(self) -> List[dict]:
+        import pickle
+        out: List[dict] = []
+        if not os.path.exists(self._path):
+            return out
+        with open(self._path, "rb") as f:
+            while True:
+                try:
+                    out.append(pickle.load(f))
+                except EOFError:
+                    break
+                except Exception:
+                    break  # torn tail record from a dying driver
+        return out
+
+    def save_result(self, trial_id: str, payload: dict) -> None:
+        import pickle
+        tdir = os.path.join(self.exp_dir, trial_id)
+        os.makedirs(tdir, exist_ok=True)
+        tmp = os.path.join(tdir, "result.pkl.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=5)
+        os.replace(tmp, os.path.join(tdir, "result.pkl"))
+
+    def load_result(self, trial_id: str) -> Optional[dict]:
+        import pickle
+        p = os.path.join(self.exp_dir, trial_id, "result.pkl")
+        if not os.path.exists(p):
+            return None
+        try:
+            with open(p, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            return None
+
+
 class Tuner:
     def __init__(self, trainable: Callable, *,
                  param_space: Optional[Dict[str, Any]] = None,
@@ -184,11 +245,41 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_dir: Optional[str] = None
+
+    @classmethod
+    def restore(cls, path: str,
+                trainable: Optional[Callable] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory (parity:
+        tune/tuner.py Tuner.restore): completed trials are loaded from
+        disk and NOT re-run; suggested-but-unfinished trials re-run with
+        their original configs; remaining samples are generated as usual.
+        Pass ``trainable`` to override the persisted one (reference
+        requires re-passing it; here it's stored but may be stale)."""
+        from ray_tpu.core import serialization
+        spec_path = os.path.join(path, "tuner.pkl")
+        if not os.path.exists(spec_path):
+            raise FileNotFoundError(
+                f"no experiment state under {path!r} (tuner.pkl missing)")
+        with open(spec_path, "rb") as f:
+            spec = serialization.loads(f.read())
+        tuner = cls.__new__(cls)
+        tuner._trainable = trainable or spec["trainable"]
+        tuner.param_space = spec["param_space"]
+        tuner.tune_config = spec["tune_config"]
+        tuner.run_config = spec["run_config"]
+        tuner._restore_dir = path
+        return tuner
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "tuner.pkl"))
 
     def fit(self) -> ResultGrid:
         import pickle
 
         import ray_tpu as rtp
+        from ray_tpu.core import serialization
         tc = self.tune_config
         if tc.search_alg is not None:
             searcher = tc.search_alg
@@ -196,10 +287,66 @@ class Tuner:
             from ray_tpu.tune.search import BasicVariantSearcher
             searcher = BasicVariantSearcher(
                 self.param_space, tc.num_samples, tc.seed)
-        exp_dir = os.path.join(
-            self.run_config.storage_path or tempfile.gettempdir(),
-            self.run_config.name or f"tune_{int(time.time())}")
+        if self._restore_dir is not None:
+            exp_dir = self._restore_dir
+        else:
+            # Unnamed experiments get a UNIQUE dir: with the durable
+            # journal, a same-second name collision would silently replay
+            # another experiment's trials as this one's.
+            import uuid as _uuid
+            exp_dir = os.path.join(
+                self.run_config.storage_path or tempfile.gettempdir(),
+                self.run_config.name or
+                f"tune_{int(time.time())}_{_uuid.uuid4().hex[:8]}")
         os.makedirs(exp_dir, exist_ok=True)
+        ledger = _ExperimentLedger(exp_dir)
+        spec_path = os.path.join(exp_dir, "tuner.pkl")
+        if self._restore_dir is None and os.path.exists(spec_path):
+            raise RuntimeError(
+                f"experiment dir {exp_dir!r} already holds an experiment; "
+                "resume it with Tuner.restore(path) or pick a different "
+                "RunConfig.name")
+        if not os.path.exists(spec_path):
+            tmp = spec_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(serialization.dumps({
+                    "trainable": self._trainable,
+                    "param_space": self.param_space,
+                    "tune_config": tc,
+                    "run_config": self.run_config}))
+            os.replace(tmp, spec_path)
+
+        # -- replay the journal (restore path; empty on a fresh run) ----
+        suggested: List[tuple] = []          # (trial_id, config) in order
+        completed: Dict[str, dict] = {}
+        for rec in ledger.load():
+            if rec.get("event") == "suggest":
+                suggested.append((rec["trial_id"], rec["config"]))
+            elif rec.get("event") == "complete":
+                completed[rec["trial_id"]] = rec
+        results: List[Result] = []
+        pending: List[tuple] = []            # unfinished -> re-run as-is
+        for trial_id, cfg in suggested:
+            # Advance the searcher past this id deterministically; the
+            # RECORDED config wins either way.
+            try:
+                searcher.suggest(trial_id)
+            except Exception:
+                pass
+            done = completed.get(trial_id)
+            payload = ledger.load_result(trial_id) if done else None
+            if done and payload is not None:
+                searcher.on_trial_complete(trial_id, payload["metrics"])
+                results.append(Result(
+                    metrics=payload["metrics"],
+                    checkpoint=payload["checkpoint"],
+                    error=RuntimeError(payload["error"])
+                    if payload["error"] else None,
+                    config=payload["config"],
+                    path=os.path.join(exp_dir, trial_id)))
+            else:
+                pending.append((trial_id, cfg))
+
         scheduler = tc.scheduler or FIFOScheduler()
         board_cls = rtp.remote(_TrialBoard)
         board = board_cls.options(max_concurrency=16).remote(
@@ -212,11 +359,19 @@ class Tuner:
         # None = unbounded concurrency (the scheduler/leases throttle) —
         # matches the pre-searcher behavior of launching every variant
         max_conc = tc.max_concurrent_trials or (1 << 30)
-        results: List[Result] = []
         inflight = {}
-        next_idx = 0
+        next_idx = len(suggested)
         exhausted = False
-        while not exhausted or inflight:
+
+        def launch(trial_id: str, cfg: dict) -> None:
+            ref = run_remote.remote(
+                self._trainable, cfg, trial_id, board,
+                os.path.join(exp_dir, trial_id))
+            inflight[ref] = trial_id
+
+        while pending or not exhausted or inflight:
+            while pending and len(inflight) < max_conc:
+                launch(*pending.pop(0))
             while not exhausted and len(inflight) < max_conc:
                 trial_id = f"trial_{next_idx:05d}"
                 cfg = searcher.suggest(trial_id)
@@ -224,10 +379,9 @@ class Tuner:
                     exhausted = True
                     break
                 next_idx += 1
-                ref = run_remote.remote(
-                    self._trainable, cfg, trial_id, board,
-                    os.path.join(exp_dir, trial_id))
-                inflight[ref] = trial_id
+                ledger.append({"event": "suggest", "trial_id": trial_id,
+                               "config": cfg})
+                launch(trial_id, cfg)
             if not inflight:
                 break
             ready, _ = rtp.wait(list(inflight), num_returns=1, timeout=600)
@@ -235,6 +389,11 @@ class Tuner:
                 trial_id = inflight.pop(ref)
                 out = rtp.get(ref)
                 searcher.on_trial_complete(trial_id, out["metrics"])
+                ledger.save_result(trial_id, {
+                    "metrics": out["metrics"],
+                    "checkpoint": out["checkpoint"],
+                    "config": out["config"], "error": out["error"]})
+                ledger.append({"event": "complete", "trial_id": trial_id})
                 results.append(Result(
                     metrics=out["metrics"], checkpoint=out["checkpoint"],
                     error=RuntimeError(out["error"]) if out["error"] else None,
