@@ -69,7 +69,16 @@ enum Status : uint8_t {
   ST_TIMEOUT = 4,
   ST_ERR = 5,
   ST_NOT_SEALED = 6,
+  // create() hit an id whose previous incarnation is pending_delete with
+  // live reader pins: the name cannot be reused until the pins drain.
+  // Clients retry instead of assuming the object is present (the old
+  // behavior returned ST_EXISTS while get() said NOT_FOUND — an object
+  // that "existed" but was unreadable for an unbounded window).
+  ST_BUSY = 7,
 };
+
+volatile sig_atomic_t g_shutdown = 0;
+void on_term(int) { g_shutdown = 1; }
 
 struct ObjectId {
   char b[16];
@@ -153,8 +162,15 @@ class Store {
     return "/dev/shm" + shm_name;  // shm_name starts with "/"
   }
 
+  // Seal contract: a recycled segment is handed over WITHOUT zeroing (the
+  // faulted-in pages are the whole point of recycling); the writer must
+  // fill [0, size) before SEAL or readers can observe a prior object's
+  // bytes. Both in-tree writers (pwrite put path, push-chunk receive)
+  // write the full range.
   Status create(const ObjectId& id, uint64_t size, int fd) {
-    if (objects_.count(id)) return ST_EXISTS;
+    auto eit = objects_.find(id);
+    if (eit != objects_.end())
+      return eit->second.pending_delete ? ST_BUSY : ST_EXISTS;
     if (size > capacity_) return ST_OOM;
     if (used_ + pool_bytes_ + size > capacity_ &&
         !evict(used_ + pool_bytes_ + size - capacity_))
@@ -336,6 +352,39 @@ class Store {
     return freed >= need;
   }
 
+  // Unlink EVERY shm segment this store owns (live objects, recycle pool,
+  // spill files, owner marker). Run on orderly shutdown and on parent
+  // death: a crashed session must not strand tmpfs pages — the reference's
+  // plasma arena is one mmap'd file the kernel reclaims on process exit
+  // (store_runner.cc); per-object segments need this explicit sweep.
+  void cleanup_all() {
+    for (auto& [id, o] : objects_) {
+      if (o.state == SPILLED)
+        unlink(spill_path_for(id).c_str());
+      else
+        shm_unlink(o.shm_name.c_str());
+    }
+    objects_.clear();
+    for (auto& [cap, name] : pool_) shm_unlink(name.c_str());
+    pool_.clear();
+    shm_unlink(("/" + prefix_ + "owner").c_str());
+    used_ = pool_bytes_ = spilled_bytes_ = 0;
+  }
+
+  // Owner marker: /dev/shm/<prefix>owner holds our pid so an out-of-band
+  // sweeper (cluster/hygiene.py) can associate stranded segments with a
+  // dead store and unlink them even after a SIGKILL (which no watchdog
+  // survives).
+  void write_owner_marker() {
+    std::string name = "/" + prefix_ + "owner";
+    int fd = shm_open(name.c_str(), O_CREAT | O_TRUNC | O_RDWR, 0600);
+    if (fd < 0) return;
+    char buf[32];
+    int n = snprintf(buf, sizeof(buf), "%d\n", (int)getpid());
+    if (write(fd, buf, n) != n) { /* best-effort marker */ }
+    close(fd);
+  }
+
   std::unordered_map<ObjectId, Object, ObjectIdHash> objects_;
   std::multimap<uint64_t, std::string> pool_;  // capacity -> shm name
   std::string prefix_;
@@ -431,6 +480,9 @@ class Server {
 
   int run() {
     signal(SIGPIPE, SIG_IGN);
+    signal(SIGTERM, on_term);
+    signal(SIGINT, on_term);
+    ppid_ = getppid();
     listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (listen_fd_ < 0) return perror("socket"), 1;
     sockaddr_un addr{};
@@ -451,8 +503,19 @@ class Server {
 
     std::vector<epoll_event> events(128);
     for (;;) {
+      // Parent-death watchdog: this daemon is spawned by the node daemon
+      // (or a driver embedding one); if that process dies — SIGKILL
+      // included — we are reparented and must not outlive it holding
+      // tmpfs pages (reference: the raylet supervises plasma's lifetime
+      // by colocation, plasma/store_runner.cc).
+      if (g_shutdown || getppid() != ppid_) {
+        store_->cleanup_all();
+        unlink(sock_path_.c_str());
+        return 0;
+      }
       int timeout = waiters_.empty() ? 1000 : 50;
       int n = epoll_wait(ep_, events.data(), (int)events.size(), timeout);
+      if (n < 0 && errno == EINTR) continue;  // signal: re-check flag
       for (int i = 0; i < n; i++) {
         int fd = events[i].data.fd;
         if (fd == listen_fd_) {
@@ -754,6 +817,7 @@ class Server {
 
   Store* store_;
   std::string sock_path_;
+  pid_t ppid_ = -1;
   int listen_fd_ = -1, ep_ = -1;
   std::unordered_map<int, Conn> conns_;
   std::list<Waiter> waiters_;
@@ -772,6 +836,9 @@ int main(int argc, char** argv) {
   std::string spill_dir = argc > 4 ? argv[4] : "";
   if (!spill_dir.empty()) mkdir(spill_dir.c_str(), 0700);
   Store store(argv[3], strtoull(argv[2], nullptr, 10), spill_dir);
+  store.write_owner_marker();
   Server srv(&store, argv[1]);
-  return srv.run();
+  int rc = srv.run();
+  store.cleanup_all();
+  return rc;
 }

@@ -46,9 +46,15 @@ class _ForkedProc:
         self._pidfd = -1
         try:
             self._pidfd = os.pidfd_open(pid)
+        except ProcessLookupError:
+            # Already exited and kernel-reaped (the zygote SIG_IGNs
+            # SIGCHLD, so the pid frees immediately). Falling back to
+            # kill(pid, 0) here would let a RECYCLED pid make this dead
+            # worker look alive indefinitely — record death now.
+            self.returncode = 1
         except Exception:
-            # Already exited (reaped) or pidfd unsupported: distinguish by
-            # a direct probe below.
+            # pidfd unsupported (ENOSYS etc): signal-0 probing is the only
+            # liveness signal available.
             pass
 
     def poll(self) -> Optional[int]:
@@ -161,8 +167,17 @@ class NodeDaemon:
         self._avail = dict(resources)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        self._owns_session_dir = session_dir is None
         self.session_dir = session_dir or tempfile.mkdtemp(prefix="rtpu-session-")
         os.makedirs(self.session_dir, exist_ok=True)
+        # Hygiene: claim this session (so the sweep knows it's live) and
+        # reclaim whatever dead sessions left behind before allocating shm.
+        from ray_tpu.cluster import hygiene
+        hygiene.write_pidfile(self.session_dir)
+        try:
+            hygiene.sweep_stale()
+        except Exception:
+            pass  # best-effort; never block startup
         # --- object store (one shmstored per node) ---
         self.store_prefix = f"rtpu-{self.node_id.hex()[:8]}-"
         self.store_socket = os.path.join(
@@ -1288,6 +1303,16 @@ class NodeDaemon:
         self.server.stop()
         try:
             self.store.close()
-            self.store_proc.kill()
+            # SIGTERM first: lets the store unlink its segments (its
+            # cleanup_all path); escalate only if it lingers.
+            self.store_proc.terminate()
+            try:
+                self.store_proc.wait(timeout=2.0)
+            except Exception:
+                self.store_proc.kill()
+                self.store_proc.wait()  # reap: no zombie for driver life
         except Exception:
             pass
+        if self._owns_session_dir:
+            import shutil
+            shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -39,8 +39,8 @@ from typing import Dict, List, Optional, Tuple
 
 OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_STATS, \
     OP_LIST, OP_GET_COPY, OP_PUT_INLINE, OP_GET_COPY_BATCH = range(1, 12)
-ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_OOM, ST_TIMEOUT, ST_ERR, ST_NOT_SEALED = \
-    range(7)
+ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_OOM, ST_TIMEOUT, ST_ERR, ST_NOT_SEALED, \
+    ST_BUSY = range(8)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "_native")
@@ -102,6 +102,7 @@ class _MapCache:
             = {}  # (dev, ino) -> (kept_fd, mmap, size)
         self._order: "deque[Tuple[int, int]]" = deque()
         self._bytes = 0
+        self._last_sweep = 0.0
         self._lock = threading.Lock()
 
     def lookup(self, fd: int, size: int) -> Optional[mmap.mmap]:
@@ -110,6 +111,14 @@ class _MapCache:
         st = os.fstat(fd)
         key = (st.st_dev, st.st_ino)
         with self._lock:
+            # Sweep from the read path too (rate-limited): a process that
+            # stops WRITING must still drop pins on segments the store
+            # already unlinked, or its cached fd+mmap keep tmpfs pages
+            # resident that the store's accounting says are free.
+            now = time.monotonic()
+            if now - self._last_sweep > 0.5:
+                self._last_sweep = now
+                self._sweep_unlinked_locked()
             ent = self._entries.get(key)
             if ent is not None and ent[2] == size:
                 self._order.remove(key)
@@ -133,6 +142,13 @@ class _MapCache:
                 kfd, _kmm, ksize = self._entries.pop(key)
                 self._bytes -= ksize
                 os.close(kfd)  # mmap ref dropped; GC unmaps when unused
+
+    def sweep(self) -> None:
+        """Periodic-timer entry point (ShmClient's 1Hz drain loop): drop
+        pins on store-unlinked segments even when this process has gone
+        idle on the put path."""
+        with self._lock:
+            self._sweep_unlinked_locked()
 
     def insert(self, fd: int, size: int) -> None:
         """Map (unfaulted; faults resolve on first cached write) and keep a
@@ -251,6 +267,7 @@ class ShmClient:
             time.sleep(1.0)
             if self._closed:
                 return
+            _map_cache.sweep()
             if not self._deferred_releases:
                 continue
             try:
@@ -296,14 +313,27 @@ class ShmClient:
         return f"/dev/shm/{self._prefix}{oid.hex()}"
 
     def _create_rpc(self, oid: bytes, size: int) -> None:
-        resp = self._call(struct.pack("<B16sQ", OP_CREATE, oid, size))
-        st = resp[0]
-        if st == ST_OOM:
-            raise ObjectStoreFullError(f"object of {size} bytes doesn't fit")
-        if st == ST_EXISTS:
-            raise ObjectStoreError(f"object {oid.hex()} already exists")
-        if st != ST_OK:
-            raise ObjectStoreError(f"create failed: status {st}")
+        deadline = time.monotonic() + 5.0
+        while True:
+            resp = self._call(struct.pack("<B16sQ", OP_CREATE, oid, size))
+            st = resp[0]
+            if st == ST_BUSY:
+                # Previous incarnation of this id is pending_delete with
+                # live reader pins; the name frees once they drain. Retry
+                # briefly rather than mis-reporting "already exists".
+                if time.monotonic() < deadline:
+                    time.sleep(0.002)
+                    continue
+                raise ObjectStoreError(
+                    f"object {oid.hex()} stuck pending delete (pinned)")
+            if st == ST_OOM:
+                raise ObjectStoreFullError(
+                    f"object of {size} bytes doesn't fit")
+            if st == ST_EXISTS:
+                raise ObjectStoreError(f"object {oid.hex()} already exists")
+            if st != ST_OK:
+                raise ObjectStoreError(f"create failed: status {st}")
+            return
 
     def create(self, oid: bytes, size: int) -> memoryview:
         """Reserve an object and return a writable view; seal() when done."""

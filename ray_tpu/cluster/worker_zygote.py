@@ -13,10 +13,11 @@ connection): {"argv": [...], "env": {...}, "cwd": null|str, "log": path}
 one (same --token registration handshake); if the zygote is unavailable it
 falls back to subprocess spawn.
 
-Fork discipline: the zygote imports the worker modules but never creates
-threads, RPC clients, or store connections (verified: importing
-worker_main starts no threads), so the child inherits only clean module
-state. The child closes the listener + request sockets, applies the
+Fork discipline: the zygote imports the worker modules but creates no
+RPC clients or store connections (verified: importing worker_main starts
+no threads), so the child inherits only clean module state. Its one
+thread — the parent-death watchdog — holds no locks at any point, so
+forking around it is safe (the thread simply doesn't exist in the child). The child closes the listener + request sockets, applies the
 request env/cwd, redirects stdout/stderr to the worker log, and enters
 ``worker_main.main()``. SIGCHLD is ignored so exited workers are reaped by
 the kernel (the daemon supervises worker liveness itself, by pid).
@@ -30,12 +31,35 @@ import os
 import signal
 import socket
 import sys
+import threading
+import time
+
+
+def _parent_watchdog(sock_path: str) -> None:
+    """Exit when the spawning node daemon dies (SIGKILL included): a
+    reparented zygote holds the imported worker stack (~150MB RSS) forever
+    and nothing will ever ask it to fork again. getppid() flips to the
+    reaper's pid on parent death — poll it (PR_SET_PDEATHSIG is
+    thread-scoped in the parent and so unusable from a Popen'd child).
+    Parity: worker-lifetime supervision, reference worker_pool.h:156."""
+    ppid = os.getppid()
+    while True:
+        time.sleep(1.0)
+        if os.getppid() != ppid:
+            try:
+                os.unlink(sock_path)
+            except OSError:
+                pass
+            os._exit(0)
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--socket", required=True)
     args = ap.parse_args()
+
+    threading.Thread(target=_parent_watchdog, args=(args.socket,),
+                     daemon=True, name="parent-watchdog").start()
 
     # Pay the import cost ONCE, before accepting fork requests — including
     # the modules worker_main.main() imports lazily (runtime_cluster/api

@@ -159,7 +159,11 @@ class RefTracker:
             else:
                 with self._lock:
                     events, self._events = self._events, []
-                if not events:
+                    # A forced resync must go out even with an empty
+                    # buffer: the sentinel epoch is rejected server-side,
+                    # which is what routes us into the snapshot replay.
+                    need_resync = self._epoch == "force-resync"
+                if not events and not need_resync:
                     return
                 batch_id = uuid.uuid4().hex
             with self._lock:
@@ -184,6 +188,13 @@ class RefTracker:
                         self._events = [e for e in events
                                         if isinstance(e[1], list)] + \
                             self._events
+                # Wake a parked flusher into its retry timer: this flush
+                # may have been called from a NON-loop thread (pin with
+                # flush=True during a conductor outage), and without a
+                # notify the buffered deltas strand until some unrelated
+                # ref event arrives.
+                with self._cv:
+                    self._cv.notify()
                 return
             self._pending_batch = None
             if resp.get("resync"):
@@ -226,11 +237,13 @@ class RefTracker:
                 # parking would strand its -1 deltas until some unrelated
                 # ref event happened to arrive.
                 while not self._events and not self._stopped and \
-                        self._pending_batch is None:
+                        self._pending_batch is None and \
+                        self._epoch != "force-resync":
                     self._cv.wait()
                 if self._stopped and not self._events:
                     return
-                retrying = self._pending_batch is not None and \
+                retrying = (self._pending_batch is not None or
+                            self._epoch == "force-resync") and \
                     not self._events
             time.sleep(0.5 if retrying else _FLUSH_INTERVAL_S)
             self.flush()

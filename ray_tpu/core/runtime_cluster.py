@@ -648,6 +648,7 @@ class ClusterRuntime:
             from ray_tpu.cluster.node_daemon import NodeDaemon
             total = self._default_resources(num_cpus, num_tpus, resources)
             session_dir = tempfile.mkdtemp(prefix="rtpu-session-")
+            self._session_dir = session_dir
             self._owned_conductor = Conductor(
                 persist_dir=session_dir
                 if config.get("conductor_persist") else None)
@@ -1338,3 +1339,9 @@ class ClusterRuntime:
                 self._owned_conductor.stop()
             except Exception:
                 pass
+        # Head mode made the session dir; a clean shutdown retires it (a
+        # crashed one is reclaimed by hygiene.sweep_stale on next start).
+        sd = getattr(self, "_session_dir", None)
+        if sd is not None:
+            import shutil
+            shutil.rmtree(sd, ignore_errors=True)

@@ -99,11 +99,22 @@ def cmd_stop(args) -> None:
             except (ValueError, ProcessLookupError):
                 pass
         os.remove(PID_FILE)
-    subprocess.run(["pkill", "-f", "ray_tpu[.]cluster[.]worker_main"],
-                   check=False)
+    # The whole process family, not just workers: a surviving zygote holds
+    # the imported worker stack, a surviving shmstored holds tmpfs pages.
+    for pattern in ("ray_tpu[.]cluster[.]worker_main",
+                    "ray_tpu[.]cluster[.]worker_zygote",
+                    "_native/shmstored"):  # path-anchored: never matches
+        subprocess.run(["pkill", "-f", pattern], check=False)  # innocents
     if os.path.exists(ADDRESS_FILE):
         os.remove(ADDRESS_FILE)
-    print(f"stopped {n} processes")
+    # Reclaim shm segments + session dirs the killed tree leaves behind.
+    # Scratch (ckpt/algo dirs) is swept only here — an explicit teardown —
+    # never at session start, where a live experiment may still hold them.
+    time.sleep(0.5)  # let SIGTERM'd stores run their own cleanup first
+    from ray_tpu.cluster import hygiene
+    removed = hygiene.sweep_stale(include_scratch=True)
+    print(f"stopped {n} processes"
+          + (f", swept {len(removed)} stale artifacts" if removed else ""))
 
 
 def _connect(args):

@@ -1,0 +1,179 @@
+"""Process-tree hygiene: nothing survives the driver, ever.
+
+Role parity: the reference supervises worker lifetimes through the raylet
+(worker_pool.h:156) and reclaims plasma's single arena file with the
+process (plasma/store_runner.cc). Our store/zygote daemons carry
+parent-death watchdogs, and cluster/hygiene.py sweeps what a SIGKILL'd
+tree strands. These tests kill a REAL driver and assert zero survivors.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+from ray_tpu.cluster import hygiene
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def test_sigkill_driver_reaps_store_zygote_and_segments(tmp_path):
+    """SIGKILL the driver mid-session: the store and zygote must notice
+    parent death and exit, and the store must unlink every shm segment it
+    owns on the way out."""
+    info_file = tmp_path / "info"
+    driver = subprocess.Popen(
+        [sys.executable, "-c", textwrap.dedent(f"""
+            import json, os, signal, time
+            signal.alarm(120)  # self-destruct: never leak past the suite
+            import jax; jax.config.update("jax_platforms", "cpu")
+            import ray_tpu
+            ray_tpu.init(num_cpus=2)
+            from ray_tpu.core.api import _global_runtime
+            rt = _global_runtime()
+            d = rt._owned_daemon
+            # Put something big enough to be a real segment, keep the ref.
+            ref = ray_tpu.put(b"x" * (4 << 20))
+            # Wait for the zygote to come up (warm thread) so the test
+            # covers it.
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                z = d._zygote_proc
+                if z not in (None, False):
+                    break
+                time.sleep(0.1)
+            z = d._zygote_proc
+            with open({str(info_file)!r} + ".tmp", "w") as f:
+                json.dump({{"store_pid": d.store_proc.pid,
+                           "zygote_pid": getattr(z, "pid", None),
+                           "prefix": d.store_prefix,
+                           "session_dir": d.session_dir}}, f)
+            os.replace({str(info_file)!r} + ".tmp", {str(info_file)!r})
+            time.sleep(600)
+        """)],
+        env={**os.environ,
+             "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                 "PYTHONPATH", ""),
+             "JAX_PLATFORMS": "cpu"},
+        stdout=open(tmp_path / "driver.out", "wb"),
+        stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 60
+        while not info_file.exists() and time.time() < deadline:
+            assert driver.poll() is None, \
+                f"driver died early:\n{open(tmp_path/'driver.out').read()}"
+            time.sleep(0.1)
+        assert info_file.exists()
+        import json
+        info = json.loads(info_file.read_text())
+        assert _alive(info["store_pid"])
+        # The segment group exists while the driver lives.
+        prefix = info["prefix"]
+        assert any(n.startswith(prefix) for n in os.listdir("/dev/shm"))
+    finally:
+        driver.send_signal(signal.SIGKILL)
+        driver.wait()
+
+    # Watchdogs: store polls ppid each epoll tick (<=1s), zygote each 1s.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        store_gone = not _alive(info["store_pid"])
+        zyg_gone = info["zygote_pid"] is None or \
+            not _alive(info["zygote_pid"])
+        if store_gone and zyg_gone:
+            break
+        time.sleep(0.2)
+    assert not _alive(info["store_pid"]), "shmstored outlived its driver"
+    if info["zygote_pid"] is not None:
+        assert not _alive(info["zygote_pid"]), "zygote outlived its driver"
+    # The store's parent-death path unlinks every segment (incl. owner
+    # marker and recycle pool).
+    time.sleep(0.5)
+    leaked = [n for n in os.listdir("/dev/shm") if n.startswith(prefix)]
+    assert leaked == [], f"leaked shm segments: {leaked}"
+    # The stranded session dir is reclaimed by the next session's sweep.
+    hygiene.sweep_stale()
+    assert not os.path.isdir(info["session_dir"])
+
+
+def test_clean_shutdown_leaves_nothing():
+    """An ordinary init/put/shutdown cycle retires its segments, session
+    dir, and daemons."""
+    import ray_tpu
+    from ray_tpu.core.api import _global_runtime
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)  # cluster mode: real store + daemons
+    rt = _global_runtime()
+    d = rt._owned_daemon
+    prefix, session_dir = d.store_prefix, d.session_dir
+    store_pid = d.store_proc.pid
+    ray_tpu.put(b"y" * (2 << 20))
+    ray_tpu.shutdown()
+    deadline = time.time() + 5
+    while _alive(store_pid) and time.time() < deadline:
+        time.sleep(0.1)
+    assert not _alive(store_pid)
+    leaked = [n for n in os.listdir("/dev/shm") if n.startswith(prefix)]
+    assert leaked == [], f"leaked shm segments: {leaked}"
+    assert not os.path.isdir(session_dir)
+
+
+def test_sweep_reclaims_dead_owner_groups(tmp_path):
+    """sweep_stale removes shm groups + session dirs whose recorded owner
+    is dead, and never touches live-owner ones."""
+    # A dead-owner shm group (pid 2**22-odd is virtually never alive; find
+    # a genuinely dead one).
+    dead = 4_100_000
+    while _alive(dead):
+        dead += 1
+    live_prefix, dead_prefix = "rtpu-aaaa1111-", "rtpu-bbbb2222-"
+    for prefix, pid in ((live_prefix, os.getpid()), (dead_prefix, dead)):
+        with open(f"/dev/shm/{prefix}owner", "w") as f:
+            f.write(f"{pid}\n")
+        with open(f"/dev/shm/{prefix}0123", "w") as f:
+            f.write("data")
+    # Session dirs: one live, one dead.
+    live_dir = "/tmp/rtpu-session-hyglive"
+    dead_dir = "/tmp/rtpu-session-hygdead"
+    for d, pid in ((live_dir, os.getpid()), (dead_dir, dead)):
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "daemon.pid"), "w") as f:
+            f.write(f"{pid}\n")
+    try:
+        removed = hygiene.sweep_stale()
+        assert any(dead_prefix in r for r in removed)
+        assert not os.path.exists(f"/dev/shm/{dead_prefix}0123")
+        assert not os.path.isdir(dead_dir)
+        # Live ones untouched.
+        assert os.path.exists(f"/dev/shm/{live_prefix}0123")
+        assert os.path.isdir(live_dir)
+    finally:
+        for n in list(os.listdir("/dev/shm")):
+            if n.startswith(live_prefix) or n.startswith(dead_prefix):
+                os.unlink(os.path.join("/dev/shm", n))
+        import shutil
+        shutil.rmtree(live_dir, ignore_errors=True)
+        shutil.rmtree(dead_dir, ignore_errors=True)
+
+
+def test_sweep_grace_protects_unowned_fresh_dirs():
+    """A just-created group with no owner record yet must survive the
+    sweep (mid-startup race)."""
+    prefix = "rtpu-cccc3333-"
+    with open(f"/dev/shm/{prefix}fresh", "w") as f:
+        f.write("data")
+    try:
+        hygiene.sweep_stale()
+        assert os.path.exists(f"/dev/shm/{prefix}fresh")
+    finally:
+        os.unlink(f"/dev/shm/{prefix}fresh")
