@@ -34,6 +34,14 @@ class Searcher:
                           result: Optional[dict]) -> None:
         pass
 
+    def register_suggestion(self, trial_id: str, config: dict) -> None:
+        """Adopt an externally-recorded suggestion (experiment restore:
+        the journal holds the config this searcher produced in a previous
+        process; fold it in WITHOUT re-running suggest(), which would
+        advance the rng differently — parity role: searcher save/restore,
+        reference python/ray/tune/search/searcher.py)."""
+        pass
+
 
 class BasicVariantSearcher(Searcher):
     """Pre-generated grid x random variants (basic_variant.py role)."""
@@ -50,6 +58,9 @@ class BasicVariantSearcher(Searcher):
         cfg = self._variants[self._i]
         self._i += 1
         return cfg
+
+    def register_suggestion(self, trial_id: str, config: dict) -> None:
+        self._i += 1  # the recorded config consumed this variant slot
 
 
 class TPESearcher(Searcher):
@@ -164,6 +175,10 @@ class TPESearcher(Searcher):
                 cfg[k] = v.sample(self._rng)
         self._pending[trial_id] = cfg
         return cfg
+
+    def register_suggestion(self, trial_id: str, config: dict) -> None:
+        self._suggested += 1
+        self._pending[trial_id] = dict(config)
 
     def on_trial_complete(self, trial_id: str,
                           result: Optional[dict]) -> None:

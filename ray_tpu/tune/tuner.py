@@ -37,6 +37,11 @@ class TuneConfig:
     search_alg: Optional[Any] = None
     seed: int = 0
     resources_per_trial: Dict[str, float] = field(default_factory=dict)
+    # Per-trial wall clock deadline. A trial past it is force-cancelled
+    # and counts as a failure (retryable under FailureConfig) — the
+    # round-4 postmortem found drivers stuck in fit() for 90 minutes
+    # behind one wedged trial.
+    trial_timeout_s: Optional[float] = None
 
 
 class _TrialBoard:
@@ -63,6 +68,12 @@ class _TrialBoard:
     def complete(self, trial_id: str) -> bool:
         self.scheduler.on_trial_complete(trial_id)
         return True
+
+    def get_scheduler_blob(self) -> bytes:
+        """Scheduler state for the experiment snapshot (PBT population,
+        ASHA brackets) — restored into a fresh board on Tuner.restore."""
+        import pickle
+        return pickle.dumps(self.scheduler)
 
     def get_history(self, trial_id: str) -> List[dict]:
         return self.history.get(trial_id, [])
@@ -231,6 +242,38 @@ class _ExperimentLedger:
         except Exception:
             return None
 
+    # -- search-state snapshots ----------------------------------------
+    # The journal records WHAT was suggested/completed; the snapshot
+    # records the searcher's internal state (rng position, TPE
+    # observations) and the scheduler's (PBT population), so a restored
+    # experiment continues the SAME search instead of silently diverging
+    # (reference: searcher save/restore, tune/search/searcher.py).
+
+    def save_search_state(self, searcher, seen: set, completed: set,
+                          scheduler_blob: Optional[bytes]) -> None:
+        import pickle
+        tmp = os.path.join(self.exp_dir, "search_state.pkl.tmp")
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump({"searcher": searcher, "seen": set(seen),
+                             "completed": set(completed),
+                             "scheduler_blob": scheduler_blob}, f,
+                            protocol=5)
+            os.replace(tmp, os.path.join(self.exp_dir, "search_state.pkl"))
+        except Exception:
+            pass  # snapshot is an optimization; the journal is the truth
+
+    def load_search_state(self) -> Optional[dict]:
+        import pickle
+        p = os.path.join(self.exp_dir, "search_state.pkl")
+        if not os.path.exists(p):
+            return None
+        try:
+            with open(p, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            return None
+
 
 class Tuner:
     def __init__(self, trainable: Callable, *,
@@ -324,19 +367,33 @@ class Tuner:
                 suggested.append((rec["trial_id"], rec["config"]))
             elif rec.get("event") == "complete":
                 completed[rec["trial_id"]] = rec
+        # Search-state snapshot: resume the SAME search (rng position, TPE
+        # observations, PBT population) instead of replaying suggest()
+        # against a fresh searcher, which silently diverges the stream.
+        seen: set = set()
+        completed_set: set = set()
+        scheduler_blob: Optional[bytes] = None
+        snap = ledger.load_search_state() if self._restore_dir else None
+        if snap is not None:
+            searcher = snap["searcher"]
+            seen = snap["seen"]
+            completed_set = snap["completed"]
+            scheduler_blob = snap.get("scheduler_blob")
         results: List[Result] = []
         pending: List[tuple] = []            # unfinished -> re-run as-is
         for trial_id, cfg in suggested:
-            # Advance the searcher past this id deterministically; the
-            # RECORDED config wins either way.
-            try:
-                searcher.suggest(trial_id)
-            except Exception:
-                pass
+            if trial_id not in seen:
+                # Journal ran ahead of the snapshot (crash between the
+                # two writes): fold the RECORDED config in without
+                # re-running suggest().
+                searcher.register_suggestion(trial_id, cfg)
+                seen.add(trial_id)
             done = completed.get(trial_id)
             payload = ledger.load_result(trial_id) if done else None
             if done and payload is not None:
-                searcher.on_trial_complete(trial_id, payload["metrics"])
+                if trial_id not in completed_set:
+                    searcher.on_trial_complete(trial_id, payload["metrics"])
+                    completed_set.add(trial_id)
                 results.append(Result(
                     metrics=payload["metrics"],
                     checkpoint=payload["checkpoint"],
@@ -350,7 +407,7 @@ class Tuner:
         scheduler = tc.scheduler or FIFOScheduler()
         board_cls = rtp.remote(_TrialBoard)
         board = board_cls.options(max_concurrency=16).remote(
-            pickle.dumps(scheduler))
+            scheduler_blob or pickle.dumps(scheduler))
         res = dict(tc.resources_per_trial) or {"CPU": 1.0}
         run_remote = rtp.remote(_run_trial).options(
             num_cpus=res.get("CPU", 1.0), num_tpus=res.get("TPU", 0.0),
@@ -359,7 +416,12 @@ class Tuner:
         # None = unbounded concurrency (the scheduler/leases throttle) —
         # matches the pre-searcher behavior of launching every variant
         max_conc = tc.max_concurrent_trials or (1 << 30)
-        inflight = {}
+        from ray_tpu.air.config import FailureConfig
+        fc = self.run_config.failure_config or FailureConfig()
+        inflight: Dict[Any, str] = {}
+        launched_at: Dict[Any, float] = {}
+        trial_cfgs: Dict[str, dict] = dict(suggested)
+        failures: Dict[str, int] = {}
         next_idx = len(suggested)
         exhausted = False
 
@@ -368,6 +430,51 @@ class Tuner:
                 self._trainable, cfg, trial_id, board,
                 os.path.join(exp_dir, trial_id))
             inflight[ref] = trial_id
+            launched_at[ref] = time.monotonic()
+            trial_cfgs[trial_id] = cfg
+
+        def snapshot() -> None:
+            blob = None
+            try:
+                blob = rtp.get(board.get_scheduler_blob.remote(),
+                               timeout=30)
+            except Exception:
+                pass
+            ledger.save_search_state(searcher, seen, completed_set, blob)
+
+        def finish(trial_id: str, out: dict) -> None:
+            searcher.on_trial_complete(trial_id, out["metrics"])
+            completed_set.add(trial_id)
+            ledger.save_result(trial_id, {
+                "metrics": out["metrics"],
+                "checkpoint": out["checkpoint"],
+                "config": out["config"], "error": out["error"]})
+            ledger.append({"event": "complete", "trial_id": trial_id})
+            snapshot()
+            results.append(Result(
+                metrics=out["metrics"], checkpoint=out["checkpoint"],
+                error=RuntimeError(out["error"]) if out["error"] else None,
+                config=out["config"],
+                path=os.path.join(exp_dir, trial_id)))
+
+        def fail(trial_id: str, err: str) -> None:
+            """Infra-level trial failure (worker death after task retries,
+            or deadline): re-launch under the trial failure budget, else
+            record a failed Result (parity: per-trial retry,
+            reference tune/execution/trial_runner.py:1179 area)."""
+            n = failures.get(trial_id, 0) + 1
+            failures[trial_id] = n
+            if fc.max_failures < 0 or n <= fc.max_failures:
+                launch(trial_id, trial_cfgs[trial_id])
+                return
+            finish(trial_id, {"trial_id": trial_id, "metrics": {},
+                              "checkpoint": None,
+                              "config": trial_cfgs[trial_id],
+                              "error": err})
+            if fc.fail_fast:
+                raise RuntimeError(
+                    f"trial {trial_id} failed permanently "
+                    f"(fail_fast): {err}")
 
         while pending or not exhausted or inflight:
             while pending and len(inflight) < max_conc:
@@ -381,24 +488,36 @@ class Tuner:
                 next_idx += 1
                 ledger.append({"event": "suggest", "trial_id": trial_id,
                                "config": cfg})
+                seen.add(trial_id)
+                snapshot()
                 launch(trial_id, cfg)
             if not inflight:
                 break
-            ready, _ = rtp.wait(list(inflight), num_returns=1, timeout=600)
+            ready, _ = rtp.wait(list(inflight), num_returns=1,
+                                timeout=5 if tc.trial_timeout_s else 600)
             for ref in ready:
                 trial_id = inflight.pop(ref)
-                out = rtp.get(ref)
-                searcher.on_trial_complete(trial_id, out["metrics"])
-                ledger.save_result(trial_id, {
-                    "metrics": out["metrics"],
-                    "checkpoint": out["checkpoint"],
-                    "config": out["config"], "error": out["error"]})
-                ledger.append({"event": "complete", "trial_id": trial_id})
-                results.append(Result(
-                    metrics=out["metrics"], checkpoint=out["checkpoint"],
-                    error=RuntimeError(out["error"]) if out["error"] else None,
-                    config=out["config"],
-                    path=os.path.join(exp_dir, out["trial_id"])))
+                launched_at.pop(ref, None)
+                try:
+                    out = rtp.get(ref)
+                except BaseException as e:  # noqa: BLE001 - worker died
+                    # after task-level retries; trial budget decides
+                    fail(trial_id, f"trial worker died: {e!r}")
+                    continue
+                finish(trial_id, out)
+            if tc.trial_timeout_s is not None:
+                nowm = time.monotonic()
+                expired = [r for r, t0 in launched_at.items()
+                           if nowm - t0 > tc.trial_timeout_s]
+                for ref in expired:
+                    trial_id = inflight.pop(ref)
+                    launched_at.pop(ref, None)
+                    try:
+                        rtp.cancel(ref, force=True)
+                    except Exception:
+                        pass
+                    fail(trial_id, "trial exceeded trial_timeout_s="
+                         f"{tc.trial_timeout_s}")
         rtp.kill(board)
         return ResultGrid(results, tc.metric, tc.mode)
 

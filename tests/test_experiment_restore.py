@@ -24,7 +24,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _spawn_driver(body: str, tmp_path) -> subprocess.Popen:
-    script = textwrap.dedent(body)
+    # Self-destruct: if the killing test itself dies (suite timeout, OOM),
+    # the driver must not linger holding CPUs — round 4's bench found
+    # three of these still alive 90 minutes later.
+    script = "import signal; signal.alarm(300)\n" + textwrap.dedent(body)
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"  # never the real chip from a test driver

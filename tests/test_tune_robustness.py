@@ -1,0 +1,179 @@
+"""Tuner robustness: trial failure budgets, deadlines, search-state restore.
+
+Role parity: per-trial retry (reference tune/execution/trial_runner.py:1179
+area, FailureConfig semantics air/config.py:512) and searcher save/restore
+(tune/search/searcher.py) — a restored TPE experiment must continue the
+SAME suggestion stream, not silently diverge.
+"""
+
+import os
+import pickle
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import FailureConfig, RunConfig
+from ray_tpu.tune.search import TPESearcher
+from ray_tpu.tune.search_space import uniform, choice
+
+
+@pytest.fixture
+def rt4():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_trial_worker_death_retried_under_failure_budget(rt4, tmp_path):
+    """A trial whose worker dies until task-level retries are exhausted is
+    re-launched under FailureConfig.max_failures and the experiment still
+    completes (previously: one such trial aborted the whole fit())."""
+    marker = tmp_path / "attempts"
+
+    def trainable(config):
+        if config["i"] == 1:
+            with open(marker, "a") as f:
+                f.write("x")
+            # Die hard through the original + 3 task-level retries; the
+            # 5th attempt (trial-level relaunch) succeeds.
+            if os.path.getsize(marker) <= 4:
+                os._exit(1)
+        return {"score": float(config["i"])}
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"i": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path), name="fb",
+                             failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert len(grid) == 3
+    assert grid.get_best_result().metrics["score"] == 2.0
+    # every trial reported (the dying one recovered on relaunch)
+    scores = sorted(r.metrics.get("score") for r in grid if r.error is None)
+    assert scores == [0.0, 1.0, 2.0]
+    assert open(marker).read().count("x") == 5
+
+
+def test_trial_failure_budget_exhausted_records_error(rt4, tmp_path):
+    """With max_failures=0 a permanently-dying trial is recorded as a
+    failed Result; the rest of the experiment completes."""
+    def trainable(config):
+        if config["i"] == 1:
+            os._exit(1)
+        return {"score": float(config["i"])}
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"i": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path), name="fb0",
+                             failure_config=FailureConfig(max_failures=0)),
+    ).fit()
+    assert len(grid) == 3
+    assert len(grid.errors) == 1
+    assert grid.get_best_result().metrics["score"] == 2.0
+
+
+def test_trial_timeout_cancels_wedged_trial(rt4, tmp_path):
+    """A trial past trial_timeout_s is force-cancelled and recorded as a
+    failure instead of wedging fit() forever (the round-4 postmortem found
+    drivers stuck 90 minutes behind one hung trial)."""
+    def trainable(config):
+        if config["i"] == 1:
+            time.sleep(600)
+        return {"score": float(config["i"])}
+
+    t0 = time.monotonic()
+    grid = tune.Tuner(
+        trainable,
+        param_space={"i": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    trial_timeout_s=5.0),
+        run_config=RunConfig(storage_path=str(tmp_path), name="ttl",
+                             failure_config=FailureConfig(max_failures=0)),
+    ).fit()
+    assert time.monotonic() - t0 < 120
+    assert len(grid) == 2
+    assert len(grid.errors) == 1
+    assert "trial_timeout_s" in repr(grid.errors[0])
+    assert grid.get_best_result().metrics["score"] == 0.0
+
+
+SPACE = {"lr": uniform(0.0, 1.0), "opt": choice(["a", "b", "c"])}
+
+
+def _drive(searcher, n, start=0):
+    out = []
+    for i in range(start, start + n):
+        cfg = searcher.suggest(f"t{i:03d}")
+        out.append(cfg)
+        searcher.on_trial_complete(
+            f"t{i:03d}", {"m": (cfg["lr"] - 0.3) ** 2})
+    return out
+
+
+def test_tpe_snapshot_resumes_same_stream():
+    """A pickled-and-restored TPESearcher continues the exact suggestion
+    stream of the uninterrupted one (rng position + observations survive)."""
+    s_cont = TPESearcher(SPACE, 30, metric="m", mode="min", seed=7)
+    s_snap = TPESearcher(SPACE, 30, metric="m", mode="min", seed=7)
+    a = _drive(s_cont, 10)
+    b = _drive(s_snap, 10)
+    assert a == b
+    restored = pickle.loads(pickle.dumps(s_snap))   # snapshot round-trip
+    assert _drive(s_cont, 10, start=10) == _drive(restored, 10, start=10)
+
+
+def test_register_suggestion_reconciles_journal_ahead_of_snapshot():
+    """register_suggestion folds a journal-recorded config in without
+    re-running suggest(): counts advance, and completing that trial feeds
+    the recorded config (not a re-randomized one) into the model."""
+    s = TPESearcher(SPACE, 10, metric="m", mode="min", seed=3)
+    cfg = {"lr": 0.123, "opt": "b"}
+    s.register_suggestion("t000", cfg)
+    assert s._suggested == 1
+    s.on_trial_complete("t000", {"m": 0.5})
+    assert s._obs and s._obs[0][0] == cfg
+
+    from ray_tpu.tune.search import BasicVariantSearcher
+    bv = BasicVariantSearcher({"x": choice([1, 2])}, num_samples=2, seed=0)
+    first = bv.suggest("t0")
+    bv2 = BasicVariantSearcher({"x": choice([1, 2])}, num_samples=2, seed=0)
+    bv2.register_suggestion("t0", first)
+    # the recorded suggestion consumed the slot: streams line up after it
+    assert bv2.suggest("t1") == bv.suggest("t1")
+
+
+def test_tuner_restore_uses_search_state_snapshot(rt4, tmp_path):
+    """End-to-end: a TPE experiment interrupted after N trials restores
+    with its observations intact (search_state.pkl), so the restored run
+    records them instead of starting the model cold."""
+    ran = tmp_path / "count"
+
+    def trainable(config):
+        with open(ran, "a") as f:
+            f.write("x")
+        return {"m": (config["lr"] - 0.3) ** 2}
+
+    searcher = TPESearcher(SPACE, 6, metric="m", mode="min", seed=11)
+    tuner = tune.Tuner(
+        trainable,
+        tune_config=tune.TuneConfig(metric="m", mode="min",
+                                    search_alg=searcher,
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="tpe"))
+    tuner.fit()
+    assert open(ran).read().count("x") == 6
+    exp_dir = str(tmp_path / "tpe")
+    assert os.path.exists(os.path.join(exp_dir, "search_state.pkl"))
+
+    # Restore over the finished experiment: nothing re-runs, and the
+    # restored searcher carries all six observations.
+    restored = tune.Tuner.restore(exp_dir, trainable=trainable)
+    grid = restored.fit()
+    assert len(grid) == 6
+    assert open(ran).read().count("x") == 6  # no re-runs
