@@ -143,7 +143,8 @@ class StandardAutoscaler:
                  idle_timeout_s: float = 30.0,
                  update_interval_s: float = 1.0,
                  max_workers: int = 20,
-                 zombie_grace_s: float = 600.0):
+                 zombie_grace_s: float = 600.0,
+                 min_per_type: Optional[Dict[str, int]] = None):
         from ray_tpu.cluster.protocol import get_client
         self.conductor = get_client(conductor_address)
         self.provider = provider
@@ -151,6 +152,10 @@ class StandardAutoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.update_interval_s = update_interval_s
         self.max_workers = max_workers
+        # Reconciled per-type floor (cluster-launcher min_workers): the
+        # loop replenishes below-floor types and idle-termination never
+        # drops a type below it.
+        self.min_per_type = dict(min_per_type or {})
         # How long a provider node may run with ZERO registered cluster
         # nodes before it is terminated (covers boot time; after that it's
         # a cost leak — dead slice or broken startup script). The default
@@ -167,6 +172,19 @@ class StandardAutoscaler:
         load = self.conductor.call("cluster_load")
         workers = self.provider.non_terminated_nodes()
         launched: Dict[str, int] = {}
+        # Replenish the per-type floor first (a zombie sweep or crash may
+        # have dropped below it).
+        if self.min_per_type:
+            have: Dict[str, int] = {}
+            for _, t in workers:
+                have[t] = have.get(t, 0) + 1
+            for tname, floor in self.min_per_type.items():
+                for _ in range(max(0, floor - have.get(tname, 0))):
+                    if len(workers) + sum(launched.values()) >= \
+                            self.max_workers:
+                        break
+                    self.provider.create_node(tname)
+                    launched[tname] = launched.get(tname, 0) + 1
         if len(workers) < self.max_workers:
             # per-type caps are cluster-wide: subtract what already runs
             existing: Dict[str, int] = {}
@@ -205,10 +223,18 @@ class StandardAutoscaler:
                 self._idle_since.setdefault(nid, now)
             else:
                 self._idle_since.pop(nid, None)
+        type_of = dict(workers)
+        remaining: Dict[str, int] = {}
+        for _, t in workers:
+            remaining[t] = remaining.get(t, 0) + 1
         for provider_id, nids in per_provider.items():
             if all(nid in self._idle_since and
                    now - self._idle_since[nid] > self.idle_timeout_s
                    for nid in nids):
+                t = type_of.get(provider_id, "")
+                if remaining.get(t, 0) <= self.min_per_type.get(t, 0):
+                    continue  # never drop below the floor
+                remaining[t] = remaining.get(t, 0) - 1
                 self.provider.terminate_node(provider_id)
                 for nid in nids:
                     self._idle_since.pop(nid, None)

@@ -1,0 +1,364 @@
+"""Cluster launcher: bring a whole cluster up/down from a YAML spec.
+
+Role parity: `ray up/down/attach/exec/submit` (reference
+python/ray/scripts/scripts.py:1223 up, :1522 submit; schema
+python/ray/autoscaler/ray-schema.json; node bootstrap
+python/ray/autoscaler/_private/updater.py). TPU-first differences:
+
+- Workers are provisioned as whole ICI slices through the provider
+  (GcpTpuNodeProvider) and bootstrap by STARTUP SCRIPT, not SSH command
+  streams — TPU VMs take a metadata startup script natively, which
+  removes the reference's ssh/updater machinery from the critical path.
+- The monitor (autoscaler + providers) runs inside the head session
+  process (`python -m ray_tpu.cluster_launcher --head-session ...`),
+  the same placement as the reference's monitor.py on the head node.
+
+YAML schema (subset, see examples/cluster.yaml):
+
+    cluster_name: demo
+    provider:
+      type: fake | gcp_tpu
+      project: my-proj          # gcp_tpu
+      zone: us-central2-b       # gcp_tpu
+    head:
+      port: 6380
+      resources: {"CPU": 4}
+      dashboard_port: 8265      # optional, -1 disables
+    node_types:
+      tpu_worker:
+        accelerator_type: v5litepod-8   # gcp_tpu
+        resources: {"TPU": 8, "CPU": 8}
+        min_workers: 1
+        max_workers: 4
+    max_workers: 8
+    idle_timeout_minutes: 5
+    setup_commands: ["pip install -e ."]   # gcp_tpu bootstrap extras
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+STATE_DIR = "/tmp/ray_tpu"
+# In-process `up` keeps its Popen handle here so `down` can reap the
+# exited session (otherwise it lingers as a zombie of the calling
+# process; CLI usage reparents to init and needs no reaping).
+_SESSIONS: Dict[str, subprocess.Popen] = {}
+
+
+def _state_path(cluster_name: str) -> str:
+    return os.path.join(STATE_DIR, f"launcher-{cluster_name}.json")
+
+
+def load_config(path: str) -> dict:
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict) or "cluster_name" not in cfg:
+        raise ValueError(f"{path}: not a cluster config (cluster_name "
+                         "missing)")
+    cfg.setdefault("provider", {"type": "fake"})
+    cfg.setdefault("head", {})
+    cfg.setdefault("node_types", {})
+    return cfg
+
+
+def _read_state(cluster_name: str) -> Optional[dict]:
+    try:
+        with open(_state_path(cluster_name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _build_provider(cfg: dict, conductor_address: str):
+    ptype = cfg["provider"].get("type", "fake")
+    node_types = cfg.get("node_types", {})
+    if ptype == "fake":
+        from ray_tpu.autoscaler.autoscaler import FakeNodeProvider
+        return FakeNodeProvider(conductor_address, node_types)
+    if ptype == "gcp_tpu":
+        from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+        return GcpTpuNodeProvider(
+            conductor_address, node_types,
+            cluster_name=cfg["cluster_name"],
+            project=cfg["provider"].get("project", ""),
+            zone=cfg["provider"].get("zone", ""))
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+# ---------------------------------------------------------------------------
+# head session: conductor + head daemon + provider + autoscaler, one process
+
+
+def run_head_session(config_path: str) -> None:
+    """The long-lived head process `up` spawns (parity: head node =
+    gcs + raylet + monitor). Exits cleanly on SIGTERM, terminating
+    provider nodes on the way out."""
+    from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+    from ray_tpu.cluster.conductor import Conductor
+    from ray_tpu.cluster.node_daemon import NodeDaemon
+
+    cfg = load_config(config_path)
+    head = cfg.get("head", {})
+    port = int(head.get("port", 6380))
+    session_dir = os.path.join(STATE_DIR, f"session-{port}")
+    os.makedirs(session_dir, exist_ok=True)
+    # No journal recovery here: every `up` is a NEW cluster, and a journal
+    # from a previous same-port cluster would resurrect its dead node
+    # entries (get_nodes would then hand `submit` a dead head address).
+    # Same-port failover belongs to `start --head`, not the launcher.
+    import shutil
+    shutil.rmtree(os.path.join(session_dir, "conductor"),
+                  ignore_errors=True)
+    conductor = Conductor(host=head.get("host", "127.0.0.1"), port=port,
+                          persist_dir=session_dir)
+    daemon = NodeDaemon(conductor.address,
+                        resources=head.get("resources"),
+                        is_head=True, session_dir=session_dir,
+                        object_store_bytes=int(
+                            head.get("object_store_memory_mb", 512)) << 20)
+    dash_port = int(head.get("dashboard_port", -1))
+    if dash_port >= 0:
+        from ray_tpu.dashboard import Dashboard
+        try:
+            Dashboard(conductor.address, port=dash_port)
+        except OSError:
+            pass
+    provider = _build_provider(cfg, conductor.address)
+    node_types = cfg.get("node_types", {})
+    # Floor the cluster at min_workers per type before demand exists.
+    for tname, tcfg in node_types.items():
+        for _ in range(int(tcfg.get("min_workers", 0))):
+            provider.create_node(tname)
+    scaler = StandardAutoscaler(
+        conductor.address, provider, node_types,
+        idle_timeout_s=float(cfg.get("idle_timeout_minutes", 5)) * 60,
+        max_workers=int(cfg.get("max_workers", 20)),
+        min_per_type={t: int(c.get("min_workers", 0))
+                      for t, c in node_types.items()})
+    scaler.start()
+
+    state = {"pid": os.getpid(), "address": conductor.address,
+             "config_path": os.path.abspath(config_path),
+             "cluster_name": cfg["cluster_name"]}
+    os.makedirs(STATE_DIR, exist_ok=True)
+    tmp = _state_path(cfg["cluster_name"]) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, _state_path(cfg["cluster_name"]))
+    print(f"HEAD_READY {conductor.address}", flush=True)
+    # The `up` CLI closes our pipe after HEAD_READY; route further output
+    # to the session log so nothing ever hits a broken pipe.
+    log_fd = os.open(os.path.join(session_dir, "launcher.log"),
+                     os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+    os.dup2(log_fd, 1)
+    os.dup2(log_fd, 2)
+    os.close(log_fd)
+
+    stop = {"flag": False}
+
+    def on_term(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    while not stop["flag"]:
+        time.sleep(0.2)
+    # Orderly teardown: provider nodes first (cloud cost), then local.
+    def _mark(msg):
+        print(f"[teardown +{time.monotonic() - t0:.1f}s] {msg}",
+              flush=True)
+    t0 = time.monotonic()
+    scaler.stop()
+    _mark("scaler stopped")
+    for pid_, _t in provider.non_terminated_nodes():
+        try:
+            provider.terminate_node(pid_)
+        except Exception:
+            pass
+        _mark(f"provider node {pid_} terminated")
+    daemon.stop()
+    _mark("head daemon stopped")
+    conductor.stop()
+    _mark("conductor stopped")
+    try:
+        os.unlink(_state_path(cfg["cluster_name"]))
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# CLI verbs
+
+
+def up(config_path: str, wait_s: float = 120.0) -> str:
+    """Bring the cluster up; returns the head address. Idempotent: a
+    live cluster with this name is left as-is."""
+    cfg = load_config(config_path)
+    st = _read_state(cfg["cluster_name"])
+    if st is not None:
+        try:
+            os.kill(st["pid"], 0)
+            print(f"cluster {cfg['cluster_name']!r} already up at "
+                  f"{st['address']}")
+            return st["address"]
+        except ProcessLookupError:
+            pass  # stale state; relaunch
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "PYTHONPATH": pkg_parent + os.pathsep +
+           os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.cluster_launcher",
+         "--head-session", os.path.abspath(config_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, start_new_session=True)   # survives this CLI exiting
+    deadline = time.monotonic() + wait_s
+    address = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("HEAD_READY"):
+            address = line.split()[1]
+            break
+    if address is None:
+        proc.terminate()
+        raise RuntimeError("head session failed to come up")
+    proc.stdout.close()   # detach; the session runs on
+    _SESSIONS[cfg["cluster_name"]] = proc
+    print(f"cluster {cfg['cluster_name']!r} up at {address}")
+    min_total = sum(int(t.get("min_workers", 0))
+                    for t in cfg.get("node_types", {}).values())
+    if min_total:
+        _wait_for_nodes(address, 1 + min_total, wait_s)
+    return address
+
+
+def _wait_for_nodes(address: str, n: int, wait_s: float) -> None:
+    from ray_tpu.cluster.protocol import get_client
+    cli = get_client(address)
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        try:
+            nodes = [x for x in cli.call("get_nodes") if x.get("alive",
+                                                               True)]
+            if len(nodes) >= n:
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    print(f"warning: cluster has not reached {n} nodes within {wait_s}s",
+          file=sys.stderr)
+
+
+def down(config_path: str, wait_s: float = 60.0) -> None:
+    """Tear the cluster down: SIGTERM the head session (which terminates
+    provider nodes), then belt-and-braces delete any labeled stragglers
+    for cloud providers."""
+    cfg = load_config(config_path)
+    st = _read_state(cfg["cluster_name"])
+    proc = _SESSIONS.pop(cfg["cluster_name"], None)
+    if st is not None:
+        try:
+            os.kill(st["pid"], signal.SIGTERM)
+        except ProcessLookupError:
+            st = None
+    if proc is not None:
+        # In-process `up`: wait on the handle (also reaps — a bare
+        # kill(pid, 0) loop would see the zombie as alive forever).
+        try:
+            proc.wait(timeout=wait_s)
+        except Exception:
+            proc.kill()
+            proc.wait()
+    elif st is not None:
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            try:
+                os.kill(st["pid"], 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.2)
+    # Cloud stragglers: the head may have died without teardown. The
+    # fake provider's nodes die with the head process; gcp ones do not.
+    if cfg["provider"].get("type") == "gcp_tpu":
+        provider = _build_provider(cfg, st["address"] if st else "")
+        for pid_, _t in provider.non_terminated_nodes():
+            try:
+                provider.terminate_node(pid_)
+            except Exception:
+                pass
+    try:
+        os.unlink(_state_path(cfg["cluster_name"]))
+    except OSError:
+        pass
+    from ray_tpu.cluster import hygiene
+    hygiene.sweep_stale()
+    print(f"cluster {cfg['cluster_name']!r} down")
+
+
+def get_head_address(config_path: str) -> str:
+    cfg = load_config(config_path)
+    st = _read_state(cfg["cluster_name"])
+    if st is None:
+        raise SystemExit(f"cluster {cfg['cluster_name']!r} is not up "
+                         "(no launcher state)")
+    return st["address"]
+
+
+def exec_cmd(config_path: str, command: str) -> int:
+    """Run a shell command against the cluster (RAY_TPU_ADDRESS set),
+    parity: `ray exec`. Local head: direct subprocess."""
+    address = get_head_address(config_path)
+    env = {**os.environ, "RAY_TPU_ADDRESS": address}
+    return subprocess.call(command, shell=True, env=env)
+
+
+def attach(config_path: str) -> int:
+    """Interactive shell wired to the cluster (parity: `ray attach`)."""
+    address = get_head_address(config_path)
+    shell = os.environ.get("SHELL", "/bin/bash")
+    env = {**os.environ, "RAY_TPU_ADDRESS": address}
+    print(f"attaching to {address} (RAY_TPU_ADDRESS set; exit to detach)")
+    return subprocess.call([shell], env=env)
+
+
+def submit(config_path: str, entrypoint: str,
+           working_dir: Optional[str] = None, follow: bool = True) -> str:
+    """Submit a job to the cluster (parity: `ray submit` /
+    `ray job submit`)."""
+    from ray_tpu.job_submission import JobSubmissionClient
+    address = get_head_address(config_path)
+    client = JobSubmissionClient(address)
+    sid = client.submit_job(
+        entrypoint=entrypoint,
+        runtime_env={"working_dir": working_dir} if working_dir else None)
+    print(f"submitted job {sid}")
+    if follow:
+        for chunk in client.tail_job_logs(sid):
+            sys.stdout.write(chunk)
+            sys.stdout.flush()
+        print(f"job {sid}: {client.get_job_status(sid)}")
+    return sid
+
+
+def main(argv=None) -> None:
+    import argparse
+    ap = argparse.ArgumentParser("ray_tpu.cluster_launcher")
+    ap.add_argument("--head-session", metavar="CONFIG",
+                    help="(internal) run the head session in-process")
+    args = ap.parse_args(argv)
+    if args.head_session:
+        run_head_session(args.head_session)
+
+
+if __name__ == "__main__":
+    main()

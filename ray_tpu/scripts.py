@@ -215,6 +215,38 @@ def cmd_job(args) -> None:
         print("stopped" if ok else "not running")
 
 
+def cmd_up(args) -> None:
+    """`ray_tpu up cluster.yaml` (parity: scripts.py:1223 `ray up`)."""
+    from ray_tpu import cluster_launcher
+    cluster_launcher.up(args.config)
+
+
+def cmd_down(args) -> None:
+    from ray_tpu import cluster_launcher
+    cluster_launcher.down(args.config)
+
+
+def cmd_attach(args) -> None:
+    from ray_tpu import cluster_launcher
+    raise SystemExit(cluster_launcher.attach(args.config))
+
+
+def cmd_exec(args) -> None:
+    from ray_tpu import cluster_launcher
+    cmd = " ".join(args.command)
+    raise SystemExit(cluster_launcher.exec_cmd(args.config, cmd))
+
+
+def cmd_submit(args) -> None:
+    from ray_tpu import cluster_launcher
+    entry = list(args.entrypoint)
+    if entry and entry[0] == "--":
+        entry = entry[1:]
+    cluster_launcher.submit(args.config, " ".join(entry),
+                            working_dir=args.working_dir,
+                            follow=not args.no_wait)
+
+
 def cmd_client_server(args) -> None:
     """`ray_tpu client-server` — run a client proxy so thin drivers can
     connect with init("client://host:port") (parity: `ray start
@@ -253,6 +285,27 @@ def main(argv=None) -> None:
 
     p = sub.add_parser("stop", help="stop local cluster processes")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("up", help="bring up a cluster from a YAML spec")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_up)
+    p = sub.add_parser("down", help="tear down a YAML-launched cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_down)
+    p = sub.add_parser("attach",
+                       help="shell with RAY_TPU_ADDRESS set to the head")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_attach)
+    p = sub.add_parser("exec", help="run a command against the cluster")
+    p.add_argument("config")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_exec)
+    p = sub.add_parser("submit", help="submit a job to a YAML cluster")
+    p.add_argument("config")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("--no-wait", action="store_true")
+    p.set_defaults(fn=cmd_submit)
 
     p = sub.add_parser("client-server",
                        help="run a client proxy for client:// drivers")

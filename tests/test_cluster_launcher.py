@@ -1,0 +1,110 @@
+"""Cluster launcher e2e: up a YAML cluster, run a job, tear it down.
+
+Role parity: `ray up/down/submit/exec` (reference
+python/ray/scripts/scripts.py:1223, autoscaler/_private/updater.py) —
+exercised against the fake provider, which places workers in the head
+session process the way the reference's fake multinode does
+(_private/fake_multi_node).
+"""
+
+import os
+import signal
+import time
+
+import yaml
+
+from ray_tpu import cluster_launcher
+from ray_tpu.cluster.protocol import get_client
+
+
+def _write_cfg(tmp_path, port, min_workers=2):
+    cfg = {
+        "cluster_name": f"t-{port}",
+        "provider": {"type": "fake"},
+        "head": {"port": port, "resources": {"CPU": 1}},
+        "node_types": {
+            "worker": {"resources": {"CPU": 1},
+                       "min_workers": min_workers, "max_workers": 4},
+        },
+        "max_workers": 6,
+        "idle_timeout_minutes": 30,
+    }
+    p = tmp_path / "cluster.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    return str(p)
+
+
+def test_up_job_down(tmp_path):
+    cfg_path = _write_cfg(tmp_path, port=6397)
+    address = cluster_launcher.up(cfg_path, wait_s=90)
+    try:
+        # 1 head + 2 min workers registered and alive.
+        nodes = [n for n in get_client(address).call("get_nodes")
+                 if n["alive"]]
+        assert len(nodes) >= 3
+        # Idempotent up: second call reuses the live cluster.
+        assert cluster_launcher.up(cfg_path) == address
+
+        # Submit a job and watch it succeed.
+        from ray_tpu.job_submission import JobSubmissionClient
+        client = JobSubmissionClient(address)
+        sid = cluster_launcher.submit(
+            cfg_path, "python -c \"print('hello-from-job')\"",
+            follow=False)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if client.get_job_status(sid) in ("SUCCEEDED", "FAILED"):
+                break
+            time.sleep(0.5)
+        assert client.get_job_status(sid) == "SUCCEEDED"
+        assert "hello-from-job" in client.get_job_logs(sid)
+
+        # exec runs with RAY_TPU_ADDRESS wired to the head.
+        marker = tmp_path / "exec-out"
+        rc = cluster_launcher.exec_cmd(
+            cfg_path, f"echo -n $RAY_TPU_ADDRESS > {marker}")
+        assert rc == 0
+        assert marker.read_text() == address
+    finally:
+        state = cluster_launcher._read_state(f"t-6397")
+        cluster_launcher.down(cfg_path)
+    # State file gone, head process gone, conductor unreachable.
+    assert cluster_launcher._read_state("t-6397") is None
+    if state:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(state["pid"], 0)
+                time.sleep(0.2)
+            except ProcessLookupError:
+                break
+        else:
+            raise AssertionError("head session survived `down`")
+
+
+def test_down_without_up_is_clean(tmp_path):
+    cfg_path = _write_cfg(tmp_path, port=6398)
+    cluster_launcher.down(cfg_path)  # no state: must not raise
+
+
+def test_up_replaces_stale_state(tmp_path):
+    """A stale launcher state file (dead pid) must not block `up`."""
+    cfg_path = _write_cfg(tmp_path, port=6399, min_workers=0)
+    os.makedirs(cluster_launcher.STATE_DIR, exist_ok=True)
+    dead = 4_200_000
+    while True:
+        try:
+            os.kill(dead, 0)
+            dead += 1
+        except ProcessLookupError:
+            break
+    import json
+    with open(cluster_launcher._state_path("t-6399"), "w") as f:
+        json.dump({"pid": dead, "address": "127.0.0.1:1",
+                   "cluster_name": "t-6399", "config_path": cfg_path}, f)
+    address = cluster_launcher.up(cfg_path, wait_s=90)
+    try:
+        assert address != "127.0.0.1:1"
+        assert get_client(address).call("get_nodes")
+    finally:
+        cluster_launcher.down(cfg_path)
