@@ -10,13 +10,17 @@ from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.dataset import Dataset, DatasetPipeline, GroupedData
 from ray_tpu.data.datasource import (from_arrow, from_items, from_numpy,
                                      from_pandas, range, read_binary_files,
-                                     read_csv, read_json, read_numpy,
-                                     read_parquet)
+                                     read_csv, read_images, read_json,
+                                     read_numpy, read_parquet,
+                                     read_tfrecords, write_tfrecords)
 from ray_tpu.data import preprocessors
 from ray_tpu.data.llm import ByteTokenizer, tokenize_and_pack
+from ray_tpu.data.tensor_ext import ArrowTensorArray, ArrowTensorType
 
 __all__ = ["Dataset", "DatasetPipeline", "GroupedData", "Block",
            "BlockAccessor", "range", "from_items", "from_numpy",
            "from_pandas", "from_arrow", "read_parquet", "read_csv",
-           "read_json", "read_numpy", "read_binary_files", "preprocessors",
-           "ByteTokenizer", "tokenize_and_pack"]
+           "read_json", "read_numpy", "read_binary_files", "read_images",
+           "read_tfrecords", "write_tfrecords", "preprocessors",
+           "ByteTokenizer", "tokenize_and_pack", "ArrowTensorArray",
+           "ArrowTensorType"]

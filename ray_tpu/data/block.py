@@ -36,26 +36,18 @@ def block_from_rows(rows: List[Any]) -> Block:
 
 
 def block_from_numpy(arrays: Dict[str, np.ndarray]) -> Block:
+    from ray_tpu.data.tensor_ext import tensor_column
     out = {}
     for k, v in arrays.items():
         v = np.asarray(v)
         if v.ndim <= 1:
             out[k] = pa.array(v)
         else:
-            # tensor column: fixed-size-list encoding, shape in metadata
-            flat = v.reshape(len(v), -1)
-            out[k] = pa.FixedSizeListArray.from_arrays(
-                pa.array(flat.ravel()), flat.shape[1])
-            # shape restored in to_numpy via _tensor_shapes metadata
-    t = pa.table(out)
-    shapes = {k: np.asarray(v).shape[1:] for k, v in arrays.items()
-              if np.asarray(v).ndim > 1}
-    if shapes:
-        import json
-        meta = {b"_tensor_shapes": json.dumps(
-            {k: list(s) for k, s in shapes.items()}).encode()}
-        t = t.replace_schema_metadata(meta)
-    return t
+            # tensor column: ArrowTensorType extension (shape carried by
+            # the TYPE, zero-copy to_numpy; parity:
+            # air/util/tensor_extensions/arrow.py)
+            out[k] = tensor_column(v)
+    return pa.table(out)
 
 
 def block_from_pandas(df) -> Block:
@@ -93,12 +85,25 @@ class BlockAccessor:
 
     def to_numpy(self, columns: Optional[List[str]] = None
                  ) -> Dict[str, np.ndarray]:
+        from ray_tpu.data.tensor_ext import is_tensor_type
         cols = columns or self.block.column_names
         shapes = self._tensor_shapes()
         out = {}
         for name in cols:
             col = self.block.column(name)
-            if pa.types.is_fixed_size_list(col.type):
+            if is_tensor_type(col.type):
+                chunks = col.chunks if isinstance(col, pa.ChunkedArray) \
+                    else [col]
+                parts = []
+                for c in chunks:
+                    try:
+                        parts.append(c.to_numpy(zero_copy_only=True))
+                    except (pa.ArrowInvalid, ValueError):
+                        parts.append(c.to_numpy(zero_copy_only=False))
+                out[name] = parts[0] if len(parts) == 1 \
+                    else np.concatenate(parts, axis=0)
+            elif pa.types.is_fixed_size_list(col.type):
+                # legacy metadata-shaped tensor blocks (pre-extension)
                 flat = col.combine_chunks().flatten().to_numpy(
                     zero_copy_only=False)
                 n = self.block.num_rows

@@ -162,16 +162,18 @@ class _Op:
 class _OneToOneOp(_Op):
     """Per-block task stage (lowered to streaming.MapOp)."""
 
-    def __init__(self, task_fn, *args):
+    def __init__(self, task_fn, *args, name: str = "map"):
         self.task_fn = task_fn
         self.args = args
+        self.name = name
 
 
 class _AllToAllOp(_Op):
     """Barrier stage — shuffle/repartition/sort (streaming.AllToAllOp)."""
 
-    def __init__(self, fn: Callable):
+    def __init__(self, fn: Callable, name: str = "all-to-all"):
         self.fn = fn
+        self.name = name
 
 
 class _LimitOp(_Op):
@@ -188,6 +190,7 @@ class Dataset:
         self._source_refs = source_refs
         self._ops = ops or []
         self._materialized: Optional[List[Any]] = None
+        self._last_exec_ops = None   # physical ops of the last execution
 
     # -- plan building ---------------------------------------------------
     def _with_op(self, op: _Op) -> "Dataset":
@@ -198,21 +201,24 @@ class Dataset:
         import cloudpickle
         return self._with_op(_OneToOneOp(
             _map_batches_task, cloudpickle.dumps(fn), batch_size,
-            batch_format))
+            batch_format, name="map_batches"))
 
     def map(self, fn: Callable) -> "Dataset":
         import cloudpickle
         return self._with_op(_OneToOneOp(_map_rows_task,
-                                         cloudpickle.dumps(fn), False))
+                                         cloudpickle.dumps(fn), False,
+                                         name="map"))
 
     def flat_map(self, fn: Callable) -> "Dataset":
         import cloudpickle
         return self._with_op(_OneToOneOp(_map_rows_task,
-                                         cloudpickle.dumps(fn), True))
+                                         cloudpickle.dumps(fn), True,
+                                         name="flat_map"))
 
     def filter(self, fn: Callable) -> "Dataset":
         import cloudpickle
-        return self._with_op(_OneToOneOp(_filter_task, cloudpickle.dumps(fn)))
+        return self._with_op(_OneToOneOp(_filter_task, cloudpickle.dumps(fn),
+                                         name="filter"))
 
     def limit(self, n: int) -> "Dataset":
         return self._with_op(_LimitOp(n))
@@ -221,14 +227,16 @@ class Dataset:
         from ray_tpu.data.shuffle import push_based_shuffle
         return self._with_op(_AllToAllOp(
             lambda refs, submit: push_based_shuffle(refs, submit,
-                                                    num_blocks, None)))
+                                                    num_blocks, None),
+            name=f"repartition[{num_blocks}]"))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         from ray_tpu.data.shuffle import push_based_shuffle
         seed = seed if seed is not None else np.random.randint(1 << 31)
         return self._with_op(_AllToAllOp(
             lambda refs, submit: push_based_shuffle(
-                refs, submit, max(1, len(refs)), seed)))
+                refs, submit, max(1, len(refs)), seed),
+            name="random_shuffle"))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         def do_sort(refs, submit):
@@ -238,7 +246,7 @@ class Dataset:
                            for r in refs]
             merged = submit(_merge_task, *sorted_refs)
             return [submit(_sort_block_task, merged, key, descending)]
-        return self._with_op(_AllToAllOp(do_sort))
+        return self._with_op(_AllToAllOp(do_sort, name=f"sort[{key}]"))
 
     def union(self, other: "Dataset") -> "Dataset":
         return Dataset(self.materialize_refs() + other.materialize_refs())
@@ -255,11 +263,12 @@ class Dataset:
         phys = []
         for op in self._ops:
             if isinstance(op, _OneToOneOp):
-                phys.append(streaming.MapOp(op.task_fn, *op.args))
+                phys.append(streaming.MapOp(op.task_fn, *op.args,
+                                            name=op.name))
             elif isinstance(op, _LimitOp):
                 phys.append(streaming.LimitOp(op.n))
             elif isinstance(op, _AllToAllOp):
-                phys.append(streaming.AllToAllOp(op.fn))
+                phys.append(streaming.AllToAllOp(op.fn, name=op.name))
             else:
                 raise TypeError(f"unknown logical op {op!r}")
         return phys
@@ -271,9 +280,20 @@ class Dataset:
         if self._materialized is not None:
             return iter(self._materialized)
         from ray_tpu.data.streaming import StreamingExecutor
-        return StreamingExecutor(self._physical_ops(),
-                                 list(self._source_refs),
+        phys = self._physical_ops()
+        self._last_exec_ops = phys   # live stats view (Dataset.stats())
+        return StreamingExecutor(phys, list(self._source_refs),
                                  self._submit).run()
+
+    def stats(self) -> str:
+        """Per-operator execution summary for the most recent execution
+        (parity: Dataset.stats(), reference _internal/stats.py). Executes
+        the plan if it never ran."""
+        from ray_tpu.data.stats import DatasetStats
+        if getattr(self, "_last_exec_ops", None) is None:
+            self.materialize_refs()
+        ops = getattr(self, "_last_exec_ops", None) or []
+        return DatasetStats([op.stats for op in ops]).summary()
 
     def materialize_refs(self) -> List[Any]:
         if self._materialized is None:

@@ -96,12 +96,65 @@ def _read_binary_file(path: str):
         return block_from_rows([{"path": path, "bytes": f.read()}])
 
 
+_IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def _read_image_file(path: str, size=None, mode: Optional[str] = None):
+    """One image file -> a 1-row tensor block {image, path, height, width}.
+    Decode happens IN THE READ TASK (parallel across the cluster); the
+    tensor column feeds iter_batches -> device_put directly (parity:
+    image_datasource.py, TPU-first: decoded NHWC uint8, contiguous)."""
+    from PIL import Image
+
+    from ray_tpu.data.tensor_ext import tensor_column
+    import pyarrow as pa
+    with Image.open(path) as im:
+        if mode is not None:
+            im = im.convert(mode)
+        elif im.mode not in ("RGB", "L"):
+            im = im.convert("RGB")
+        if size is not None:
+            im = im.resize(tuple(size))
+        arr = np.asarray(im)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    return pa.table({
+        "image": tensor_column(arr[None]),
+        "path": pa.array([path]),
+        "height": pa.array([h], pa.int32()),
+        "width": pa.array([w], pa.int32()),
+    })
+
+
+def _read_tfrecords_file(path: str):
+    """One TFRecord file -> a block of decoded tf.train.Examples. Scalar
+    features unbox to scalars; bytes features stay bytes (parity:
+    tfrecords_datasource.py semantics, without the TF dependency —
+    data/tfrecord.py implements the framing + proto codec)."""
+    from ray_tpu.data.tfrecord import decode_example, read_tfrecord_frames
+    rows = []
+    for frame in read_tfrecord_frames(path):
+        ex = decode_example(frame)
+        row = {}
+        for k, v in ex.items():
+            if isinstance(v, list):      # BytesList
+                row[k] = v[0] if len(v) == 1 else v
+            elif len(v) == 1:
+                row[k] = v[0].item()
+            else:
+                row[k] = v.tolist()
+        rows.append(row)
+    return block_from_rows(rows)
+
+
 _READERS = {
     "parquet": (_read_parquet_file, ".parquet"),
     "csv": (_read_csv_file, ".csv"),
     "json": (_read_json_file, ".json"),
     "numpy": (_read_numpy_file, ".npy"),
     "binary": (_read_binary_file, None),
+    "tfrecords": (_read_tfrecords_file, None),
 }
 
 
@@ -131,6 +184,40 @@ def read_numpy(path) -> Dataset:
 
 def read_binary_files(path) -> Dataset:
     return _read_files(path, "binary")
+
+
+def read_images(path, *, size=None, mode: Optional[str] = None) -> Dataset:
+    """One decode task per image file; rows carry a fixed-shape tensor
+    column when ``size`` forces a uniform shape (feed `iter_batches`
+    straight into device pipelines), else per-file blocks of native
+    sizes."""
+    import functools
+    import ray_tpu as rt
+    files = [p for p in _expand_paths(path)
+             if p.lower().endswith(_IMAGE_SUFFIXES)]
+    if not files:
+        raise FileNotFoundError(f"no image files under {path}")
+    reader = functools.partial(_read_image_file, size=size, mode=mode)
+    remote = rt.remote(reader).options(num_cpus=1)
+    return Dataset([remote.remote(f) for f in files])
+
+
+def read_tfrecords(path) -> Dataset:
+    return _read_files(path, "tfrecords")
+
+
+def write_tfrecords(ds: Dataset, path: str) -> None:
+    """Rows -> tf.train.Example records, one file per block."""
+    import ray_tpu as rt
+    from ray_tpu.data.block import BlockAccessor
+    from ray_tpu.data.tfrecord import encode_example, write_tfrecord_frames
+    os.makedirs(path, exist_ok=True)
+    for i, ref in enumerate(ds.iter_block_refs()):
+        block = rt.get(ref)
+        recs = [encode_example(row)
+                for row in BlockAccessor(block).to_rows()]
+        write_tfrecord_frames(
+            os.path.join(path, f"part-{i:05d}.tfrecords"), recs)
 
 
 def _write_block(block, path: str, fmt: str, index: int) -> str:

@@ -27,11 +27,13 @@ class PhysicalOp:
     name = "op"
 
     def __init__(self):
+        from ray_tpu.data.stats import OpStats
         self.inq: deque = deque()
         self.outq: deque = deque()
         self.inflight: dict = {}          # ref -> list-of-downstream refs
         self.input_done = False
         self.finished = False
+        self.stats = OpStats(self.name)
 
     # -- hooks ------------------------------------------------------------
     def poke(self, executor: "StreamingExecutor") -> None:
@@ -65,6 +67,7 @@ class MapOp(PhysicalOp):
         self.task_fn = task_fn
         self.args = args
         self.name = name
+        self.stats.name = name
         self._seq_in = 0
         self._next_out = 0
         self._ready: dict = {}      # seq -> output ref
@@ -73,14 +76,17 @@ class MapOp(PhysicalOp):
         while (self.inq and len(self.inflight) < _DEFAULT_INFLIGHT and
                not self.backpressured()):
             ref = self.inq.popleft()
+            t0 = self.stats.on_submit()
             out = executor.submit(self.task_fn, ref, *self.args)
-            self.inflight[out] = self._seq_in
+            self.inflight[out] = (self._seq_in, t0)
             self._seq_in += 1
         if self.input_done and self.idle() and not self._ready:
             self.finished = True
 
     def on_task_done(self, ref) -> List[Any]:
-        self._ready[self.inflight.pop(ref)] = ref
+        seq, t0 = self.inflight.pop(ref)
+        self.stats.on_done(t0)
+        self._ready[seq] = ref
         out = []
         while self._next_out in self._ready:
             out.append(self._ready.pop(self._next_out))
@@ -97,6 +103,7 @@ class AllToAllOp(PhysicalOp):
         super().__init__()
         self.fn = fn
         self.name = name
+        self.stats.name = name
         self._collected: List[Any] = []
         self._launched = False
 
@@ -105,8 +112,12 @@ class AllToAllOp(PhysicalOp):
             self._collected.append(self.inq.popleft())
         if self.input_done and not self._launched:
             self._launched = True
+            t0 = self.stats.on_submit()
+            n = 0
             for ref in self.fn(self._collected, executor.submit):
                 self.outq.append(ref)
+                n += 1
+            self.stats.on_done(t0, n_blocks=n)
             self.finished = True
 
 
@@ -120,6 +131,7 @@ class LimitOp(PhysicalOp):
         self.n = n
         self.remaining = n
         self.name = f"limit[{n}]"
+        self.stats.name = self.name
 
     def poke(self, executor) -> None:
         import ray_tpu as rt
@@ -129,6 +141,7 @@ class LimitOp(PhysicalOp):
                 self.inq.clear()
                 break
             ref = self.inq.popleft()
+            t0 = self.stats.on_submit()
             block = rt.get(ref)
             rows = BlockAccessor(block).num_rows()
             if rows <= self.remaining:
@@ -138,6 +151,7 @@ class LimitOp(PhysicalOp):
                 self.outq.append(rt.put(
                     BlockAccessor(block).slice(0, self.remaining)))
                 self.remaining = 0
+            self.stats.on_done(t0)
         if self.remaining <= 0 or (self.input_done and self.idle()):
             self.finished = True
 

@@ -1,0 +1,98 @@
+"""Arrow tensor extension type: first-class ndarray columns.
+
+Role parity: python/ray/air/util/tensor_extensions/arrow.py
+(ArrowTensorType/ArrowTensorArray) — fixed-shape tensors stored as an
+Arrow FixedSizeList with the element shape carried by the TYPE (not table
+metadata), so tensor columns survive slicing, concatenation, selection,
+and IPC through the shm object plane, and convert back to numpy
+ZERO-COPY (one reshape over the storage buffer; no per-row boxing).
+
+TPU-first: `to_numpy` hands back a contiguous (N, *shape) host array —
+exactly the layout `jax.device_put` wants for per-host input pipelines.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pyarrow as pa
+
+
+class ArrowTensorType(pa.ExtensionType):
+    """Fixed-shape tensor column type: storage = FixedSizeList(value)."""
+
+    def __init__(self, shape, value_type):
+        self._shape = tuple(int(s) for s in shape)
+        size = 1
+        for s in self._shape:
+            size *= s
+        super().__init__(pa.list_(value_type, size), "ray_tpu.tensor")
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def value_type(self):
+        return self.storage_type.value_type
+
+    def __arrow_ext_serialize__(self) -> bytes:
+        return json.dumps({"shape": list(self._shape)}).encode()
+
+    @classmethod
+    def __arrow_ext_deserialize__(cls, storage_type, serialized):
+        shape = json.loads(serialized.decode())["shape"]
+        return cls(shape, storage_type.value_type)
+
+    def __arrow_ext_class__(self):
+        return ArrowTensorArray
+
+    def __reduce__(self):
+        return (ArrowTensorType.__arrow_ext_deserialize__,
+                (self.storage_type, self.__arrow_ext_serialize__()))
+
+
+class ArrowTensorArray(pa.ExtensionArray):
+    """Array of fixed-shape tensors."""
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray) -> "ArrowTensorArray":
+        arr = np.ascontiguousarray(arr)
+        if arr.ndim < 2:
+            raise ValueError("tensor columns need ndim >= 2 (N, *shape)")
+        typ = ArrowTensorType(arr.shape[1:], pa.from_numpy_dtype(arr.dtype))
+        flat = arr.reshape(len(arr), -1)
+        storage = pa.FixedSizeListArray.from_arrays(
+            pa.array(flat.ravel()), flat.shape[1])
+        return pa.ExtensionArray.from_storage(typ, storage)
+
+    def to_numpy(self, zero_copy_only: bool = True) -> np.ndarray:
+        """(N, *shape) ndarray over the Arrow buffer — zero-copy for
+        primitive value types without nulls."""
+        storage = self.storage
+        values = storage.values
+        flat = values.to_numpy(zero_copy_only=zero_copy_only)
+        # A sliced FixedSizeListArray shares its parent's value buffer;
+        # carve out this slice's window before reshaping.
+        size = self.type.storage_type.list_size
+        start = storage.offset * size
+        flat = flat[start:start + len(self) * size]
+        return flat.reshape((len(self), *self.type.shape))
+
+
+def tensor_column(arr: np.ndarray) -> ArrowTensorArray:
+    return ArrowTensorArray.from_numpy(arr)
+
+
+def is_tensor_type(t: pa.DataType) -> bool:
+    return isinstance(t, ArrowTensorType)
+
+
+# Registration makes the type round-trip through Arrow IPC (and therefore
+# through the shm object plane's serialized tables) in any process that
+# imported ray_tpu.data.
+try:
+    pa.register_extension_type(ArrowTensorType((1,), pa.float32()))
+except pa.ArrowKeyError:
+    pass  # already registered (repeat import)

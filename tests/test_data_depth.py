@@ -1,0 +1,155 @@
+"""Data-library depth: image/tfrecords datasources, tensor extension
+columns, per-operator stats.
+
+Role parity: reference python/ray/data/datasource/image_datasource.py,
+tfrecords_datasource.py, _internal/stats.py, and
+air/util/tensor_extensions/arrow.py.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data.block import BlockAccessor, block_from_numpy
+from ray_tpu.data.tensor_ext import ArrowTensorType
+from ray_tpu.data.tfrecord import (decode_example, encode_example,
+                                   read_tfrecord_frames,
+                                   write_tfrecord_frames)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# -- tensor extension -----------------------------------------------------
+
+def test_tensor_extension_zero_copy_and_ops():
+    imgs = np.arange(3 * 4 * 5 * 3, dtype=np.float32).reshape(3, 4, 5, 3)
+    b = block_from_numpy({"image": imgs, "label": np.array([0, 1, 2])})
+    assert isinstance(b.column("image").type, ArrowTensorType)
+    out = BlockAccessor(b).to_numpy()
+    assert out["image"].shape == (3, 4, 5, 3)
+    assert np.array_equal(out["image"], imgs)
+    assert out["image"].base is not None          # zero-copy view
+    # slice / concat keep shape and values
+    s = BlockAccessor(BlockAccessor(b).slice(1, 3)).to_numpy()["image"]
+    assert np.array_equal(s, imgs[1:3])
+    c = BlockAccessor(BlockAccessor.concat([b, b])).to_numpy()["image"]
+    assert np.array_equal(c, np.concatenate([imgs, imgs]))
+
+
+def test_tensor_extension_survives_object_plane(rt):
+    imgs = np.random.default_rng(0).normal(
+        size=(4, 8, 8, 3)).astype(np.float32)
+    ds = rdata.from_numpy(imgs, column="image")
+    got = ds.map_batches(lambda b: {"image": b["image"] * 2.0}) \
+            .take_all()
+    assert len(got) == 4
+    batches = list(rdata.from_numpy(imgs, column="image")
+                   .iter_batches(batch_size=2))
+    assert batches[0]["image"].shape == (2, 8, 8, 3)
+
+
+# -- images ---------------------------------------------------------------
+
+def test_read_images(rt, tmp_path):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        arr = rng.integers(0, 255, (10 + i, 12, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    (tmp_path / "notes.txt").write_text("not an image")
+
+    ds = rdata.read_images(str(tmp_path), size=(8, 8))
+    rows = ds.take_all()
+    assert len(rows) == 6
+    # uniform size -> batches stack into one device-feedable tensor
+    batch = next(iter(ds.iter_batches(batch_size=6)))
+    assert batch["image"].shape == (6, 8, 8, 3)
+    assert batch["image"].dtype == np.uint8
+    # native-size read keeps true dims
+    ds2 = rdata.read_images(str(tmp_path))
+    heights = sorted(r["height"] for r in ds2.take_all())
+    assert heights == [10, 11, 12, 13, 14, 15]
+
+
+# -- tfrecords ------------------------------------------------------------
+
+def test_tfrecord_codec_roundtrip(tmp_path):
+    recs = [
+        {"name": b"alpha", "score": np.asarray([1.5, 2.5], np.float32),
+         "count": np.asarray([7], np.int64)},
+        {"name": b"beta", "score": np.asarray([-0.5], np.float32),
+         "count": np.asarray([-3, 9], np.int64)},
+    ]
+    path = str(tmp_path / "x.tfrecords")
+    write_tfrecord_frames(path, [encode_example(r) for r in recs])
+    back = [decode_example(f) for f in
+            read_tfrecord_frames(path, verify_crc=True)]
+    assert back[0]["name"] == [b"alpha"]
+    assert np.allclose(back[0]["score"], [1.5, 2.5])
+    assert back[0]["count"].tolist() == [7]
+    assert back[1]["count"].tolist() == [-3, 9]
+    assert np.allclose(back[1]["score"], [-0.5])
+
+
+def test_tfrecord_decoder_against_spec_golden():
+    """Decode a byte sequence hand-derived from the tf.train.Example
+    proto spec (independent of our encoder): Example{ features{
+    feature{ key:"label" value{ int64_list{ value:[5] }}}}}."""
+    golden = bytes([
+        0x0A, 0x10,                               # Example.features len=16
+        0x0A, 0x0E,                               # Features.feature entry
+        0x0A, 0x05]) + b"label" + bytes([         # key = "label"
+        0x12, 0x05,                               # value = Feature len=5
+        0x1A, 0x03,                               # Feature.int64_list
+        0x0A, 0x01, 0x05])                        # packed varint [5]
+    ex = decode_example(golden)
+    assert ex["label"].tolist() == [5]
+    # And the UNPACKED repeated encoding (wire type 0 per element), which
+    # older writers emit, decodes identically.
+    unpacked = bytes([
+        0x0A, 0x0F, 0x0A, 0x0D, 0x0A, 0x05]) + b"label" + bytes([
+        0x12, 0x04, 0x1A, 0x02, 0x08, 0x05])      # int64 value=5, varint
+    assert decode_example(unpacked)["label"].tolist() == [5]
+
+
+def test_read_write_tfrecords_dataset(rt, tmp_path):
+    ds = rdata.from_items([{"uid": i, "w": float(i) / 2} for i in range(20)])
+    out = str(tmp_path / "recs")
+    rdata.write_tfrecords(ds, out)
+    assert any(f.endswith(".tfrecords") for f in os.listdir(out))
+    back = rdata.read_tfrecords(out)
+    rows = sorted(back.take_all(), key=lambda r: r["uid"])
+    assert len(rows) == 20
+    assert rows[3]["uid"] == 3
+    assert abs(rows[3]["w"] - 1.5) < 1e-6
+
+
+# -- stats ----------------------------------------------------------------
+
+def test_dataset_stats(rt):
+    ds = rdata.range(1000, parallelism=4) \
+        .map_batches(lambda b: {"id": b["id"] * 2}) \
+        .filter(lambda r: r["id"] % 4 == 0)
+    ds.materialize()
+    s = ds.stats()
+    assert "map_batches" in s
+    assert "filter" in s
+    assert "tasks" in s and "wall" in s
+    # all 4 blocks flowed through both operators
+    assert "4 tasks" in s
+
+
+def test_dataset_stats_executes_if_needed(rt):
+    ds = rdata.range(100, parallelism=2).map(lambda r: r)
+    s = ds.stats()          # triggers execution
+    assert "Operator map" in s
