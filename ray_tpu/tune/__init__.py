@@ -2,22 +2,30 @@
 
 Parity surface: reference python/ray/tune — Tuner (tuner.py:53),
 TrialRunner/TuneController (execution/trial_runner.py:1179), search spaces
-(grid_search/choice/uniform/...), schedulers (FIFO, ASHA
-schedulers/async_hyperband.py, median stopping, PBT pbt.py), ResultGrid.
+(grid_search/choice/uniform/...), searchers (basic variant, native TPE for
+the hyperopt role, GP-UCB for the bayesopt role, define-by-run for the
+optuna role), schedulers (FIFO, ASHA schedulers/async_hyperband.py,
+HyperBand hyperband.py, median stopping, PBT pbt.py, PB2 pb2.py),
+ResultGrid, storage sync (syncer.py).
 """
 
-from ray_tpu.tune.search import BasicVariantSearcher, Searcher, TPESearcher
+from ray_tpu.tune.search import (BasicVariantSearcher, DefineByRunSearcher,
+                                 GPSearcher, Searcher, TPESearcher,
+                                 TrialHandle)
 from ray_tpu.tune.search_space import (choice, grid_search, loguniform,
                                        randint, randn, uniform, sample_from)
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
-                                     MedianStoppingRule,
-                                     PopulationBasedTraining)
+                                     HyperBandScheduler, MedianStoppingRule,
+                                     PB2, PopulationBasedTraining)
+from ray_tpu.tune.syncer import Syncer
 from ray_tpu.tune.tuner import (ResultGrid, TuneConfig, Tuner, run)
 
 ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = ["Tuner", "TuneConfig", "ResultGrid", "run", "grid_search",
-           "Searcher", "BasicVariantSearcher", "TPESearcher",
+           "Searcher", "BasicVariantSearcher", "TPESearcher", "GPSearcher",
+           "DefineByRunSearcher", "TrialHandle",
            "choice", "uniform", "loguniform", "randint", "randn",
            "sample_from", "FIFOScheduler", "AsyncHyperBandScheduler",
-           "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining"]
+           "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
+           "PopulationBasedTraining", "PB2", "Syncer"]

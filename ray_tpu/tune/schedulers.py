@@ -104,6 +104,54 @@ class MedianStoppingRule(TrialScheduler):
         return CONTINUE if mine >= median else STOP
 
 
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand, asynchronous form (parity: schedulers/hyperband.py +
+    hb_bohb.py bracketing): incoming trials round-robin across s_max+1
+    brackets; bracket s starts culling only after grace_period * rf^s
+    iterations (aggressive brackets cut early, conservative ones late),
+    and within a bracket each rung keeps the top 1/rf of recorded
+    results. This keeps HyperBand's exploration-vs-exploitation spread
+    across brackets without the synchronous variant's pause/resume
+    machinery (our report-driven control point decides continue/stop
+    only, like ASHA's — the reference's async path does the same)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 max_t: int = 81):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        s_max = 0
+        t = grace_period
+        while t * reduction_factor <= max_t:
+            s_max += 1
+            t *= reduction_factor
+        # bracket s: ASHA with grace grace_period * rf^s
+        self._brackets = [
+            AsyncHyperBandScheduler(
+                metric=metric, mode=mode,
+                grace_period=grace_period * reduction_factor ** s,
+                reduction_factor=reduction_factor, max_t=max_t)
+            for s in range(s_max + 1)]
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def _bracket_of(self, trial_id: str) -> "AsyncHyperBandScheduler":
+        if trial_id not in self._assignment:
+            self._assignment[trial_id] = self._next % len(self._brackets)
+            self._next += 1
+        return self._brackets[self._assignment[trial_id]]
+
+    def on_result(self, trial_id, iteration, metrics) -> str:
+        return self._bracket_of(trial_id).on_result(
+            trial_id, iteration, metrics)
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        self._bracket_of(trial_id).on_trial_complete(trial_id)
+
+
 class PopulationBasedTraining(TrialScheduler):
     """PBT-lite: at each perturbation interval, bottom-quantile trials are
     told to EXPLOIT (load top-quantile config + checkpoint, with mutated
@@ -164,3 +212,60 @@ class PopulationBasedTraining(TrialScheduler):
                     "checkpoint": self._checkpoints.get(src),
                 }
         return CONTINUE
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (parity: schedulers/pb2.py): exploit like
+    PBT, but EXPLORE by a GP-bandit over the numeric hyperparameters —
+    fit a Gaussian process on (config -> latest reward) across the
+    population and pick the in-bounds candidate maximizing UCB, instead
+    of multiplying by a random factor. Categorical/list mutations fall
+    back to PBT-style choice."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict[str, tuple]] = None,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 ucb_kappa: float = 1.5, n_candidates: int = 64):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=hyperparam_mutations,
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = dict(hyperparam_bounds or {})
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+
+    def _mutate(self, config: dict) -> dict:
+        import numpy as np
+
+        from ray_tpu.tune.search import gp_posterior
+        out = super()._mutate(config)   # lists/callables PBT-style
+        keys = [k for k in self.bounds if k in config and
+                isinstance(config.get(k), (int, float))]
+        if not keys:
+            return out
+        # Observations: every trial's latest score at its current config.
+        X, y = [], []
+        for tid, score in self._latest.items():
+            cfg = self._configs.get(tid)
+            if cfg is None or not all(k in cfg for k in keys):
+                continue
+            X.append([self._norm(k, float(cfg[k])) for k in keys])
+            y.append(score)
+        rng = np.random.default_rng(self._rng.randrange(1 << 31))
+        cands = rng.uniform(0.0, 1.0, size=(self.n_candidates, len(keys)))
+        if len(X) >= 2:
+            mu, var = gp_posterior(np.asarray(X), np.asarray(y), cands)
+            best = int(np.argmax(mu + self.kappa * np.sqrt(var)))
+        else:
+            best = 0   # cold start: random in-bounds point
+        for i, k in enumerate(keys):
+            lo, hi = self.bounds[k]
+            v = lo + float(cands[best, i]) * (hi - lo)
+            out[k] = int(round(v)) if isinstance(config[k], int) else v
+        return out
+
+    def _norm(self, k: str, v: float) -> float:
+        lo, hi = self.bounds[k]
+        return (v - lo) / (hi - lo or 1.0)

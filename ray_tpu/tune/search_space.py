@@ -36,7 +36,8 @@ class _Uniform(Domain):
 class _LogUniform(Domain):
     def __init__(self, low, high):
         import math
-        self.lo, self.hi = math.log(low), math.log(high)
+        self.low, self.high = low, high      # native bounds (clamping)
+        self.lo, self.hi = math.log(low), math.log(high)  # warped
 
     def sample(self, rng):
         import math

@@ -289,17 +289,27 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self._restore_dir: Optional[str] = None
+        self._storage_uri: Optional[str] = None
 
     @classmethod
     def restore(cls, path: str,
                 trainable: Optional[Callable] = None) -> "Tuner":
-        """Resume an interrupted experiment from its directory (parity:
-        tune/tuner.py Tuner.restore): completed trials are loaded from
-        disk and NOT re-run; suggested-but-unfinished trials re-run with
-        their original configs; remaining samples are generated as usual.
-        Pass ``trainable`` to override the persisted one (reference
-        requires re-passing it; here it's stored but may be stale)."""
+        """Resume an interrupted experiment from its directory OR storage
+        URI (parity: tune/tuner.py Tuner.restore + syncer.py): completed
+        trials are loaded from disk and NOT re-run; suggested-but-
+        unfinished trials re-run with their original configs; remaining
+        samples are generated as usual. Pass ``trainable`` to override
+        the persisted one (reference requires re-passing it; here it's
+        stored but may be stale). A ``gs://``-style path downloads the
+        experiment into a local staging dir first and keeps syncing back."""
         from ray_tpu.core import serialization
+        from ray_tpu.tune.syncer import Syncer, is_uri, local_cache_dir
+        restore_uri = None
+        if is_uri(path):
+            restore_uri = path.rstrip("/")
+            local = os.path.join(local_cache_dir(restore_uri), "exp")
+            Syncer(restore_uri).sync_down(local)
+            path = local
         spec_path = os.path.join(path, "tuner.pkl")
         if not os.path.exists(spec_path):
             raise FileNotFoundError(
@@ -312,10 +322,14 @@ class Tuner:
         tuner.tune_config = spec["tune_config"]
         tuner.run_config = spec["run_config"]
         tuner._restore_dir = path
+        tuner._storage_uri = restore_uri
         return tuner
 
     @staticmethod
     def can_restore(path: str) -> bool:
+        from ray_tpu.tune.syncer import Syncer, is_uri
+        if is_uri(path):
+            return Syncer(path.rstrip("/")).exists()
         return os.path.exists(os.path.join(path, "tuner.pkl"))
 
     def fit(self) -> ResultGrid:
@@ -330,17 +344,38 @@ class Tuner:
             from ray_tpu.tune.search import BasicVariantSearcher
             searcher = BasicVariantSearcher(
                 self.param_space, tc.num_samples, tc.seed)
+        from ray_tpu.tune.syncer import Syncer, is_uri, local_cache_dir
+        syncer: Optional[Syncer] = None
         if self._restore_dir is not None:
             exp_dir = self._restore_dir
+            if self._storage_uri is not None:
+                syncer = Syncer(self._storage_uri)
         else:
             # Unnamed experiments get a UNIQUE dir: with the durable
             # journal, a same-second name collision would silently replay
             # another experiment's trials as this one's.
             import uuid as _uuid
-            exp_dir = os.path.join(
-                self.run_config.storage_path or tempfile.gettempdir(),
-                self.run_config.name or
-                f"tune_{int(time.time())}_{_uuid.uuid4().hex[:8]}")
+            name = (self.run_config.name or
+                    f"tune_{int(time.time())}_{_uuid.uuid4().hex[:8]}")
+            storage = self.run_config.storage_path or tempfile.gettempdir()
+            if is_uri(storage):
+                # Cloud storage: execute in a local staging dir, mirror
+                # up after every durable event (syncer.py role). The
+                # CLOUD is the truth for "already exists"; stale staging
+                # from an earlier same-URI run is wiped.
+                uri = storage.rstrip("/") + "/" + name
+                syncer = Syncer(uri)
+                if syncer.exists():
+                    raise RuntimeError(
+                        f"storage {uri!r} already holds an experiment; "
+                        "resume it with Tuner.restore(uri) or pick a "
+                        "different RunConfig.name")
+                import shutil
+                exp_dir = os.path.join(local_cache_dir(uri), "exp")
+                shutil.rmtree(exp_dir, ignore_errors=True)
+                self._storage_uri = uri
+            else:
+                exp_dir = os.path.join(storage, name)
         os.makedirs(exp_dir, exist_ok=True)
         ledger = _ExperimentLedger(exp_dir)
         spec_path = os.path.join(exp_dir, "tuner.pkl")
@@ -451,6 +486,11 @@ class Tuner:
                 "config": out["config"], "error": out["error"]})
             ledger.append({"event": "complete", "trial_id": trial_id})
             snapshot()
+            if syncer is not None:
+                try:
+                    syncer.sync_up(exp_dir)
+                except Exception:
+                    pass  # transient storage failure: next sync retries
             results.append(Result(
                 metrics=out["metrics"], checkpoint=out["checkpoint"],
                 error=RuntimeError(out["error"]) if out["error"] else None,
@@ -519,6 +559,8 @@ class Tuner:
                     fail(trial_id, "trial exceeded trial_timeout_s="
                          f"{tc.trial_timeout_s}")
         rtp.kill(board)
+        if syncer is not None:
+            syncer.sync_up(exp_dir)   # final mirror (journal tail)
         return ResultGrid(results, tc.metric, tc.mode)
 
 
