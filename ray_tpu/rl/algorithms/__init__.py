@@ -6,7 +6,15 @@ from ray_tpu.rl.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rl.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rl.algorithms.es import ES, ESConfig
+from ray_tpu.rl.algorithms.qmix import QMIX, QMIXConfig
+from ray_tpu.rl.algorithms.maddpg import (CoopSpreadEnv, MADDPG,
+                                          MADDPGConfig)
+from ray_tpu.rl.algorithms.bandits import (Bandit, BanditConfig,
+                                           ContextualBanditEnv)
 
 __all__ = ["PPO", "PPOConfig", "Impala", "ImpalaConfig", "DQN", "DQNConfig",
            "SAC", "SACConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
-           "APPO", "APPOConfig", "A2C", "A2CConfig", "CQL", "CQLConfig"]
+           "APPO", "APPOConfig", "A2C", "A2CConfig", "CQL", "CQLConfig",
+           "ES", "ESConfig", "QMIX", "QMIXConfig", "MADDPG", "MADDPGConfig",
+           "CoopSpreadEnv", "Bandit", "BanditConfig", "ContextualBanditEnv"]

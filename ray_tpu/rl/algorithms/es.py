@@ -1,0 +1,160 @@
+"""Evolution Strategies (parity: rllib/algorithms/es/es.py — the OpenAI-ES
+scheme): gradient-free search over policy parameters. Each iteration
+broadcasts the CURRENT weights once; workers regenerate their antithetic
+perturbations from a SEED (the reference's shared-noise-table trick —
+only seeds and fitness scalars cross the wire, never perturbed weight
+copies), evaluate an episode each way, and the driver applies the
+rank-weighted update theta += alpha/(n*sigma) * sum(F_i * eps_i).
+
+TPU-first note: the policy forward is a jitted MLP; perturbation +
+update arithmetic is flat-vector numpy on the driver — ES has no
+backward pass, so the chip's only job is batched rollout forwards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import episode_stats_of, make_env
+from ray_tpu.rl.module import make_module
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_perturbations = 16      # antithetic pairs per iteration
+        self.sigma = 0.1                 # perturbation scale
+        self.lr = 0.05
+        self.episode_horizon = 200
+        self.weight_decay = 0.005
+        self.algo_class = ES
+
+
+def _flatten(params) -> np.ndarray:
+    import jax
+    leaves = jax.tree.leaves(params)
+    return np.concatenate([np.asarray(x).ravel() for x in leaves])
+
+
+def _unflatten(params_template, flat: np.ndarray):
+    import jax
+    leaves, treedef = jax.tree.flatten(params_template)
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(np.asarray(leaf).size)
+        out.append(flat[off:off + n].reshape(np.asarray(leaf).shape)
+                   .astype(np.asarray(leaf).dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+class ESWorker:
+    """Actor: evaluates seed-derived antithetic perturbations."""
+
+    def __init__(self, env: Any, module_spec: dict, horizon: int,
+                 sigma: float, seed: int = 0):
+        import jax
+        self.env = make_env(env, num_envs=1, seed=seed)
+        self.module = make_module(module_spec)
+        self.horizon = horizon
+        self.sigma = sigma
+        template = self.module.init(jax.random.PRNGKey(0))
+        self._template = jax.device_get(template)
+        self._dim = _flatten(self._template).size
+        self._greedy = jax.jit(self.module.greedy_actions)
+
+    def _episode_return(self, flat: np.ndarray) -> float:
+        params = _unflatten(self._template, flat)
+        obs = self.env.vector_reset(seed=None)
+        total = 0.0
+        for _ in range(self.horizon):
+            a = np.asarray(self._greedy(params, obs))
+            obs, rew, done, _ = self.env.vector_step(a)
+            total += float(rew[0])
+            if bool(done[0]):
+                break
+        return total
+
+    def evaluate(self, flat_weights: np.ndarray,
+                 seeds: List[int]) -> List[tuple]:
+        """-> [(seed, F(theta+sigma*eps), F(theta-sigma*eps)), ...]."""
+        out = []
+        for s in seeds:
+            eps = np.random.default_rng(s).standard_normal(
+                self._dim).astype(np.float32)
+            out.append((s,
+                        self._episode_return(flat_weights + self.sigma * eps),
+                        self._episode_return(flat_weights - self.sigma * eps)))
+        return out
+
+    def episode_stats(self) -> dict:
+        return episode_stats_of(self.env)
+
+
+class ES(Algorithm):
+    def setup(self) -> None:
+        import jax
+        import ray_tpu as rt
+        cfg: ESConfig = self.config  # type: ignore[assignment]
+        self.module = make_module(self.module_spec)
+        params = jax.device_get(self.module.init(
+            jax.random.PRNGKey(cfg.seed)))
+        self._template = params
+        self.theta = _flatten(params)
+        self._rng = np.random.default_rng(cfg.seed)
+        worker_cls = rt.remote(ESWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=1).remote(
+                cfg.env, self.module_spec, cfg.episode_horizon, cfg.sigma,
+                seed=cfg.seed + i + 1)
+            for i in range(max(1, cfg.num_rollout_workers))]
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu as rt
+        cfg: ESConfig = self.config  # type: ignore[assignment]
+        n = cfg.num_perturbations
+        seeds = [int(s) for s in self._rng.integers(0, 1 << 31, n)]
+        chunks = np.array_split(np.asarray(seeds), len(self.workers))
+        futs = [w.evaluate.remote(self.theta, [int(x) for x in chunk])
+                for w, chunk in zip(self.workers, chunks) if len(chunk)]
+        results = [r for rs in rt.get(futs, timeout=600) for r in rs]
+        # rank transform (centered): robust to reward scale
+        pos = np.asarray([fp for _, fp, _ in results])
+        neg = np.asarray([fn for _, _, fn in results])
+        scores = pos - neg
+        ranks = np.empty(len(scores))
+        ranks[np.argsort(scores)] = np.arange(len(scores))
+        weights = ranks / max(len(scores) - 1, 1) - 0.5
+        grad = np.zeros_like(self.theta)
+        for (seed, _fp, _fn), w in zip(results, weights):
+            eps = np.random.default_rng(seed).standard_normal(
+                self.theta.size).astype(np.float32)
+            grad += w * eps
+        grad /= len(results) * cfg.sigma
+        self.theta = (1.0 - cfg.weight_decay) * self.theta + cfg.lr * grad
+        self._timesteps_total += 2 * len(results) * cfg.episode_horizon
+        return {
+            "episode_reward_mean": float(np.mean((pos + neg) / 2.0)),
+            "episode_reward_max": float(max(pos.max(), neg.max())),
+            "info/grad_norm": float(np.linalg.norm(grad)),
+        }
+
+    def get_policy_params(self):
+        return _unflatten(self._template, self.theta)
+
+    def get_state(self) -> dict:
+        return {"theta": self.theta}
+
+    def set_state(self, state: dict) -> None:
+        self.theta = state["theta"]
+
+    def stop(self) -> None:
+        import ray_tpu as rt
+        for w in self.workers:
+            try:
+                rt.kill(w)
+            except Exception:
+                pass

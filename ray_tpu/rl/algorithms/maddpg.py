@@ -1,0 +1,250 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Role parity: rllib/algorithms/maddpg/maddpg.py: each agent i owns a
+deterministic actor mu_i(o_i) trained through a CENTRALIZED critic
+Q_i(o_1..o_n, a_1..a_n) that sees every agent's observation and action
+(centralized training, decentralized execution). Target networks +
+Polyak averaging, Gaussian exploration noise, joint replay.
+
+Continuous cooperative test env included (CoopSpreadEnv): agents emit
+scalar actions and share -|a_i - target| penalties — coordination is
+only learnable through the centralized critic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import MultiAgentEnv
+from ray_tpu.rl.module import mlp_apply, mlp_init
+
+
+class CoopSpreadEnv(MultiAgentEnv):
+    """Two agents, scalar actions in [-1, 1]. Each episode draws a target
+    t; reward_i = -|a_i - t| - 0.5 * |a_0 - a_1| (hit the target AND
+    agree). Observations: [t, agent_one_hot]."""
+
+    def __init__(self, horizon: int = 10, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self.horizon = horizon
+        self._t = 0
+        self.target = 0.0
+        self.num_actions = -1     # continuous
+        self.action_dim = 1
+
+    def _obs(self):
+        return {"agent_0": np.array([self.target, 1.0, 0.0], np.float32),
+                "agent_1": np.array([self.target, 0.0, 1.0], np.float32)}
+
+    def reset(self):
+        self._t = 0
+        self.target = float(self._rng.uniform(-0.8, 0.8))
+        return self._obs()
+
+    def step(self, actions):
+        self._t += 1
+        a0 = float(np.asarray(actions["agent_0"]).ravel()[0])
+        a1 = float(np.asarray(actions["agent_1"]).ravel()[0])
+        rew = {
+            "agent_0": -abs(a0 - self.target) - 0.5 * abs(a0 - a1),
+            "agent_1": -abs(a1 - self.target) - 0.5 * abs(a0 - a1),
+        }
+        done = self._t >= self.horizon
+        return (self._obs(), rew, {a: done for a in rew},
+                {"__all__": done}, {})
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env_fn: Callable[[], MultiAgentEnv] = CoopSpreadEnv
+        self.hidden = 64
+        self.buffer_capacity = 20_000
+        self.train_batch_size = 64
+        self.updates_per_iter = 64
+        self.steps_per_iter = 200
+        self.tau = 0.02              # Polyak
+        self.noise_scale = 0.3
+        self.gamma = 0.95
+        self.actor_lr = 3e-4
+        self.critic_lr = 1e-3
+        self.actor_delay_iters = 2   # critic warms up before actors move
+        self.algo_class = MADDPG
+
+
+class MADDPG(Algorithm):
+    def __init__(self, config: MADDPGConfig):
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self.setup()
+
+    def setup(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+        cfg: MADDPGConfig = self.config  # type: ignore[assignment]
+        self.env = cfg.env_fn()
+        self._obs = self.env.reset()
+        self.agents = sorted(self._obs)
+        n = len(self.agents)
+        obs_dim = int(np.asarray(self._obs[self.agents[0]]).size)
+        adim = getattr(self.env, "action_dim", 1)
+        key = jax.random.PRNGKey(cfg.seed)
+        keys = jax.random.split(key, 2 * n)
+        joint = n * obs_dim + n * adim
+
+        def actor_init(k):
+            p = mlp_init(k, [obs_dim, cfg.hidden, cfg.hidden, adim])
+            # Near-zero head (the standard DDPG trick): initial actions
+            # sit at tanh's linear center instead of a saturated extreme
+            # a random critic can strand them in.
+            p[-1]["w"] = p[-1]["w"] * 0.01
+            return p
+
+        self.params = {
+            "actors": [actor_init(keys[i]) for i in range(n)],
+            "critics": [mlp_init(keys[n + i],
+                                 [joint, cfg.hidden, cfg.hidden, 1])
+                        for i in range(n)],
+        }
+        self.target = jax.device_get(self.params)
+        self.atx = optax.adam(cfg.actor_lr)
+        self.ctx = optax.adam(cfg.critic_lr)
+        self.aopt = self.atx.init(self.params["actors"])
+        self.copt = self.ctx.init(self.params["critics"])
+        self._rng = np.random.default_rng(cfg.seed)
+        self._buf: List[tuple] = []
+        self.episode_returns: List[float] = []
+        self._ep_return = 0.0
+        self.n, self.obs_dim, self.adim = n, obs_dim, adim
+        gamma, tau = cfg.gamma, cfg.tau
+        atx, ctx = self.atx, self.ctx
+
+        def act(actors, obs):   # obs [n, d] -> [n, adim], tanh-squashed
+            return jnp.stack([
+                jnp.tanh(mlp_apply(actors[i], obs[i][None])[0])
+                for i in range(n)])
+
+        self._act = jax.jit(act)
+
+        def critic_in(obs, acts):   # [B,n,d], [B,n,adim] -> [B, joint]
+            B = obs.shape[0]
+            return jnp.concatenate([obs.reshape(B, -1),
+                                    acts.reshape(B, -1)], axis=1)
+
+        def update(params, target, aopt, copt, batch, do_actor):
+            obs, acts, rew, nobs, done = batch   # rew [B,n]
+
+            def critic_loss(critics):
+                nacts = jnp.stack([
+                    jnp.tanh(mlp_apply(target["actors"][i], nobs[:, i]))
+                    for i in range(n)], axis=1)
+                total = 0.0
+                for i in range(n):
+                    qi = mlp_apply(critics[i],
+                                   critic_in(obs, acts))[:, 0]
+                    qn = mlp_apply(target["critics"][i],
+                                   critic_in(nobs, nacts))[:, 0]
+                    y = rew[:, i] + gamma * (1 - done) * \
+                        jax.lax.stop_gradient(qn)
+                    total = total + jnp.mean((qi - y) ** 2)
+                return total
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                params["critics"])
+            cupd, copt = ctx.update(cgrads, copt)
+            import optax as _ox
+            critics = _ox.apply_updates(params["critics"], cupd)
+
+            def actor_loss(actors):
+                total = 0.0
+                for i in range(n):
+                    pre = mlp_apply(actors[i], obs[:, i])
+                    ai = jnp.tanh(pre)
+                    joint_a = acts.at[:, i].set(ai)
+                    total = total - jnp.mean(mlp_apply(
+                        critics[i], critic_in(obs, joint_a))[:, 0])
+                    # pre-tanh penalty: keeps actions out of the
+                    # saturated zero-gradient region
+                    total = total + 1e-3 * jnp.mean(pre ** 2)
+                return total
+
+            aloss, agrads = jax.value_and_grad(actor_loss)(
+                params["actors"])
+            aupd, aopt = atx.update(agrads, aopt)
+            # actor delay: freeze actors (do_actor=0) while the critic
+            # warms up — a random critic's gradient strands tanh actors
+            aupd = jax.tree.map(lambda u: u * do_actor, aupd)
+            actors = _ox.apply_updates(params["actors"], aupd)
+            new = {"actors": actors, "critics": critics}
+            tgt = jax.tree.map(lambda t, p: (1 - tau) * t + tau * p,
+                               target, new)
+            return new, tgt, aopt, copt, closs, aloss
+
+        self._update = jax.jit(update)
+
+    def _stack_obs(self, od) -> np.ndarray:
+        return np.stack([np.asarray(od[a], np.float32)
+                         for a in self.agents])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: MADDPGConfig = self.config  # type: ignore[assignment]
+        for _ in range(cfg.steps_per_iter):
+            o = self._stack_obs(self._obs)
+            a = np.asarray(self._act(self.params["actors"], o))
+            a = np.clip(a + self._rng.normal(
+                scale=cfg.noise_scale, size=a.shape), -1.0, 1.0)
+            action_dict = {ag: a[i] for i, ag in enumerate(self.agents)}
+            nxt, rew, _dones, all_done, _ = self.env.step(action_dict)
+            done = bool(all_done.get("__all__"))
+            self._buf.append((
+                o, a.astype(np.float32),
+                np.asarray([rew[ag] for ag in self.agents], np.float32),
+                self._stack_obs(nxt) if not done else o, done))
+            if len(self._buf) > cfg.buffer_capacity:
+                self._buf.pop(0)
+            self._ep_return += float(np.mean(list(rew.values())))
+            self._timesteps_total += 1
+            if done:
+                self.episode_returns.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs = self.env.reset()
+            else:
+                self._obs = nxt
+        closs = aloss = float("nan")
+        if len(self._buf) >= cfg.train_batch_size:
+            for _ in range(cfg.updates_per_iter):
+                idx = self._rng.integers(0, len(self._buf),
+                                         cfg.train_batch_size)
+                rows = [self._buf[i] for i in idx]
+                batch = (np.stack([r[0] for r in rows]),
+                         np.stack([r[1] for r in rows]),
+                         np.stack([r[2] for r in rows]),
+                         np.stack([r[3] for r in rows]),
+                         np.asarray([r[4] for r in rows], np.float32))
+                do_actor = float(self.iteration >= cfg.actor_delay_iters)
+                (self.params, self.target, self.aopt, self.copt,
+                 closs, aloss) = self._update(
+                    self.params, self.target, self.aopt, self.copt, batch,
+                    do_actor)
+            closs, aloss = float(closs), float(aloss)
+        recent = self.episode_returns[-20:]
+        return {
+            "episode_reward_mean": float(np.mean(recent)) if recent
+            else float("nan"),
+            "info/critic_loss": closs,
+            "info/actor_loss": aloss,
+        }
+
+    def get_state(self) -> dict:
+        import jax
+        return {"params": jax.device_get(self.params),
+                "target": self.target}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target = state["target"]

@@ -1,0 +1,219 @@
+"""QMIX: cooperative multi-agent Q-learning with monotonic value mixing.
+
+Role parity: rllib/algorithms/qmix/qmix.py (+ qmix_policy.py mixer):
+per-agent Q-networks (parameter-shared — one jitted forward serves every
+agent) feed a MIXING network whose weights are produced by hypernetworks
+conditioned on the GLOBAL state, constrained non-negative (abs) so
+argmax_a Q_tot decomposes into per-agent argmaxes (the IGM property).
+Trained end-to-end on joint transitions with a target network.
+
+Exercises the MultiAgentEnv protocol: the collector steps one env,
+records per-STEP joint transitions (all agents' obs/actions, the TEAM
+reward, the global state = concat of agent observations).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import MultiAgentEnv
+from ray_tpu.rl.module import mlp_apply, mlp_init
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env_fn: Callable[[], MultiAgentEnv] = None  # required
+        self.mixing_embed_dim = 16
+        self.hidden = 32
+        self.buffer_capacity = 20_000
+        self.train_batch_size = 64
+        self.updates_per_iter = 64
+        self.steps_per_iter = 256
+        self.target_update_iters = 4
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 3_000
+        self.gamma = 0.99
+        self.lr = 1e-3
+        self.algo_class = QMIX
+
+
+def _qmix_init(key, obs_dim: int, num_actions: int, n_agents: int,
+               state_dim: int, hidden: int, embed: int) -> dict:
+    import jax
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    return {
+        # shared per-agent Q net
+        "q": mlp_init(k1, [obs_dim, hidden, hidden, num_actions]),
+        # hypernetworks: state -> mixing weights (non-negative via abs)
+        "hyper_w1": mlp_init(k2, [state_dim, embed * n_agents]),
+        "hyper_b1": mlp_init(k3, [state_dim, embed]),
+        "hyper_w2": mlp_init(k4, [state_dim, embed]),
+        "hyper_b2": mlp_init(k5, [state_dim, hidden, 1]),
+    }
+
+
+def _agent_qs(params, obs):  # obs: [B, n_agents, obs_dim]
+    import jax.numpy as jnp
+    B, n, d = obs.shape
+    q = mlp_apply(params["q"], obs.reshape(B * n, d))
+    return q.reshape(B, n, -1)
+
+
+def _mix(params, agent_q, state):
+    """agent_q: [B, n] chosen per-agent Qs; state: [B, state_dim] ->
+    Q_tot [B]. Monotonic: layer weights pass through abs()."""
+    import jax.numpy as jnp
+    w1 = jnp.abs(mlp_apply(params["hyper_w1"], state))      # [B, e*n]
+    b1 = mlp_apply(params["hyper_b1"], state)               # [B, e]
+    B, n = agent_q.shape
+    e = b1.shape[1]
+    w1 = w1.reshape(B, n, e)
+    h = jnp.einsum("bn,bne->be", agent_q, w1) + b1
+    h = jnp.where(h > 0, h, 0.01 * h)                       # leaky relu
+    w2 = jnp.abs(mlp_apply(params["hyper_w2"], state))      # [B, e]
+    b2 = mlp_apply(params["hyper_b2"], state)               # [B, 1]
+    return jnp.einsum("be,be->b", h, w2) + b2[:, 0]
+
+
+class QMIX(Algorithm):
+    def __init__(self, config: QMIXConfig):
+        # MultiAgentEnv world: no gym probe / module_spec (base init
+        # assumes a VectorEnv).
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self.setup()
+
+    def setup(self) -> None:
+        import jax
+        import optax
+        cfg: QMIXConfig = self.config  # type: ignore[assignment]
+        if cfg.env_fn is None:
+            raise ValueError("QMIXConfig.env_fn (MultiAgentEnv factory) "
+                             "is required")
+        self.env = cfg.env_fn()
+        self._obs = self.env.reset()
+        self.agents = sorted(self._obs)
+        n = len(self.agents)
+        obs_dim = int(np.asarray(self._obs[self.agents[0]]).size)
+        self.n_actions = self.env.num_actions
+        state_dim = obs_dim * n
+        self.params = _qmix_init(
+            jax.random.PRNGKey(cfg.seed), obs_dim, self.n_actions, n,
+            state_dim, cfg.hidden, cfg.mixing_embed_dim)
+        self.target_params = jax.device_get(self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._buf: List[tuple] = []
+        self._ep_return = 0.0
+        self.episode_returns: List[float] = []
+        self._q_fn = jax.jit(_agent_qs)
+        gamma, tx = cfg.gamma, self.tx
+
+        def td_step(params, target, opt_state, batch):
+            import jax.numpy as jnp
+            obs, acts, rew, nobs, done, state, nstate = batch
+
+            def loss_fn(p):
+                q = _agent_qs(p, obs)                        # [B,n,A]
+                chosen = jnp.take_along_axis(
+                    q, acts[..., None], axis=2)[..., 0]      # [B,n]
+                q_tot = _mix(p, chosen, state)
+                q_next = _agent_qs(target, nobs).max(axis=2)  # [B,n]
+                y = rew + gamma * (1.0 - done) * jax.lax.stop_gradient(
+                    _mix(target, q_next, nstate))
+                return jnp.mean((q_tot - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state)
+            import optax as _ox
+            return _ox.apply_updates(params, updates), opt_state, loss
+
+        self._td_step = jax.jit(td_step)
+        self._eps_step = 0
+
+    # -- joint-transition collection -------------------------------------
+    def _epsilon(self) -> float:
+        cfg: QMIXConfig = self.config  # type: ignore[assignment]
+        frac = min(1.0, self._eps_step / cfg.epsilon_decay_steps)
+        return cfg.epsilon_start + frac * (cfg.epsilon_end -
+                                           cfg.epsilon_start)
+
+    def _stack_obs(self, obs_dict) -> np.ndarray:
+        return np.stack([np.asarray(obs_dict[a], np.float32)
+                         for a in self.agents])
+
+    def _collect(self, steps: int) -> None:
+        cfg: QMIXConfig = self.config  # type: ignore[assignment]
+        eps = self._epsilon()
+        for _ in range(steps):
+            o = self._stack_obs(self._obs)           # [n, d]
+            q = np.asarray(self._q_fn(self.params, o[None]))[0]  # [n, A]
+            greedy = q.argmax(axis=1)
+            explore = self._rng.random(len(self.agents)) < eps
+            rand = self._rng.integers(0, self.n_actions, len(self.agents))
+            acts = np.where(explore, rand, greedy)
+            action_dict = {a: int(acts[i])
+                           for i, a in enumerate(self.agents)}
+            nxt, rew, dones, all_done, _ = self.env.step(action_dict)
+            team_r = float(sum(rew.values()))
+            done = bool(all_done.get("__all__"))
+            no = self._stack_obs(nxt) if not done else o
+            self._buf.append((o, acts.astype(np.int32), team_r, no, done))
+            if len(self._buf) > cfg.buffer_capacity:
+                self._buf.pop(0)
+            self._ep_return += team_r
+            self._eps_step += 1
+            self._timesteps_total += 1
+            if done:
+                self.episode_returns.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs = self.env.reset()
+            else:
+                self._obs = nxt
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        cfg: QMIXConfig = self.config  # type: ignore[assignment]
+        self._collect(cfg.steps_per_iter)
+        loss = float("nan")
+        if len(self._buf) >= cfg.train_batch_size:
+            for _ in range(cfg.updates_per_iter):
+                idx = self._rng.integers(0, len(self._buf),
+                                         cfg.train_batch_size)
+                rows = [self._buf[i] for i in idx]
+                obs = np.stack([r[0] for r in rows])        # [B,n,d]
+                acts = np.stack([r[1] for r in rows])
+                rew = np.asarray([r[2] for r in rows], np.float32)
+                nobs = np.stack([r[3] for r in rows])
+                done = np.asarray([r[4] for r in rows], np.float32)
+                state = obs.reshape(len(rows), -1)
+                nstate = nobs.reshape(len(rows), -1)
+                self.params, self.opt_state, loss = self._td_step(
+                    self.params, self.target_params, self.opt_state,
+                    (obs, acts, rew, nobs, done, state, nstate))
+            loss = float(loss)
+        if self.iteration % cfg.target_update_iters == 0:
+            self.target_params = jax.device_get(self.params)
+        recent = self.episode_returns[-20:]
+        return {
+            "episode_reward_mean": float(np.mean(recent)) if recent
+            else float("nan"),
+            "epsilon": self._epsilon(),
+            "info/td_loss": loss,
+        }
+
+    def get_state(self) -> dict:
+        import jax
+        return {"params": jax.device_get(self.params),
+                "target": self.target_params}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target_params = state["target"]

@@ -264,4 +264,143 @@ def make_module(spec: Dict[str, Any]):
     if encoder == "lstm":
         spec.pop("hiddens", None)
         return RecurrentRLModule(**spec)
+    if encoder in ("gtrxl", "attention"):
+        spec.pop("hiddens", None)
+        return AttentionRLModule(**spec)
     raise ValueError(f"unknown encoder {encoder!r}")
+
+
+# ---------------------------------------------------------------------------
+# GTrXL: gated transformer-XL encoder (attention catalog entry)
+# ---------------------------------------------------------------------------
+
+def _gru_gate_init(key, dim: int) -> Dict[str, Any]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale = jnp.sqrt(1.0 / dim)
+    def lin(k):
+        return (jax.random.normal(k, (2 * dim, dim)) * scale
+                ).astype(jnp.float32)
+    return {"wr": lin(k1), "wz": lin(k2), "wh": lin(k3),
+            # bg > 0 biases the update gate toward IDENTITY at init — the
+            # key trick of the GTrXL paper (arXiv:1910.06764 eq. 6): the
+            # block starts as a skip connection, which is what makes
+            # transformers trainable under an RL objective.
+            "bg": jnp.full((dim,), 2.0, jnp.float32)}
+
+
+def _gru_gate(params, x, y):
+    """GRU-style gating g(x, y): x = stream (skip), y = block output."""
+    xy = jnp.concatenate([x, y], axis=-1)
+    r = jax.nn.sigmoid(xy @ params["wr"])
+    z = jax.nn.sigmoid(xy @ params["wz"] - params["bg"])
+    h = jnp.tanh(jnp.concatenate([r * x, y], axis=-1) @ params["wh"])
+    return (1.0 - z) * x + z * h
+
+
+class AttentionRLModule:
+    """GTrXL-style policy/value net (parity: rllib attention_net.py
+    GTrXLNet, catalog use_attention): L transformer blocks with
+    layer-norm-first attention over a sliding window of past hidden
+    states (the TrXL memory), each sublayer merged into the residual
+    stream through a GRU gate biased to identity.
+
+    Sequence-first like RecurrentRLModule: apply_seq consumes [T, B, D]
+    plus a memory state [L, B, M, H] and returns (logits, values, new
+    memory). lax.scan over time keeps one compiled program per sequence
+    length; attention at step t sees the M most recent cached states."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *,
+                 hidden_size: int = 64, num_layers: int = 2,
+                 num_heads: int = 4, memory_len: int = 16,
+                 action_dim: int = 1):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.action_dim = action_dim
+        self.h = hidden_size
+        self.layers = num_layers
+        self.heads = num_heads
+        self.mem = memory_len
+        self.out_dim = num_actions if num_actions > 0 else 2 * action_dim
+
+    def init(self, key) -> Dict[str, Any]:
+        ks = jax.random.split(key, 3 + self.layers)
+        params: Dict[str, Any] = {
+            "embed": mlp_init(ks[0], (self.obs_dim, self.h)),
+            "pi": mlp_init(ks[1], (self.h, self.out_dim)),
+            "vf": mlp_init(ks[2], (self.h, 1)),
+            "blocks": [],
+        }
+        scale = jnp.sqrt(1.0 / self.h)
+        for li in range(self.layers):
+            kq, kk, kv, ko, kf1, kf2, kg1, kg2 = jax.random.split(
+                ks[3 + li], 8)
+            def lin(k, dout):
+                return (jax.random.normal(k, (self.h, dout)) * scale
+                        ).astype(jnp.float32)
+            params["blocks"].append({
+                "wq": lin(kq, self.h), "wk": lin(kk, self.h),
+                "wv": lin(kv, self.h), "wo": lin(ko, self.h),
+                "ff1": mlp_init(kf1, (self.h, 4 * self.h)),
+                "ff2": mlp_init(kf2, (4 * self.h, self.h)),
+                "gate_attn": _gru_gate_init(kg1, self.h),
+                "gate_ff": _gru_gate_init(kg2, self.h),
+            })
+        return params
+
+    def initial_state(self, batch: int):
+        return jnp.zeros((self.layers, batch, self.mem, self.h),
+                         jnp.float32)
+
+    @staticmethod
+    def _norm(x):
+        mu = x.mean(-1, keepdims=True)
+        sd = jnp.sqrt(((x - mu) ** 2).mean(-1, keepdims=True) + 1e-5)
+        return (x - mu) / sd
+
+    def _block(self, bp, x, mem_l):
+        """x [B, H]; mem_l [B, M, H] (oldest..newest) -> (out, new_mem)."""
+        B = x.shape[0]
+        hd = self.h // self.heads
+        ctx = jnp.concatenate([mem_l, x[:, None, :]], axis=1)  # [B,M+1,H]
+        xin = self._norm(x)
+        cin = self._norm(ctx)
+        q = (xin @ bp["wq"]).reshape(B, self.heads, hd)
+        k = (cin @ bp["wk"]).reshape(B, -1, self.heads, hd)
+        v = (cin @ bp["wv"]).reshape(B, -1, self.heads, hd)
+        att = jnp.einsum("bhd,bmhd->bhm", q, k) / jnp.sqrt(hd)
+        att = jax.nn.softmax(att, axis=-1)
+        y = jnp.einsum("bhm,bmhd->bhd", att, v).reshape(B, self.h)
+        y = jax.nn.relu(y @ bp["wo"])
+        x = _gru_gate(bp["gate_attn"], x, y)
+        f = mlp_apply(bp["ff2"], mlp_apply(bp["ff1"], self._norm(x),
+                                           activate_last=True))
+        x = _gru_gate(bp["gate_ff"], x, f)
+        new_mem = jnp.concatenate([mem_l[:, 1:], x[:, None, :]], axis=1)
+        return x, new_mem
+
+    def apply_seq(self, params, obs_seq, state, dones_seq=None):
+        """obs_seq [T, B, D], state [L, B, M, H] -> (logits [T, B, A],
+        values [T, B], final_state). dones zero the memory AFTER a
+        terminal step (same convention as RecurrentRLModule)."""
+        def step(mem, inp):
+            if dones_seq is None:
+                (x,) = inp
+            else:
+                x, d_prev = inp
+                mem = mem * (1.0 - d_prev)[None, :, None, None]
+            h = mlp_apply(params["embed"], x, activate_last=True)
+            new_mem = []
+            for li in range(self.layers):
+                h, m = self._block(params["blocks"][li], h, mem[li])
+                new_mem.append(m)
+            return jnp.stack(new_mem), h
+        if dones_seq is None:
+            xs = (obs_seq,)
+        else:
+            prev = jnp.concatenate(
+                [jnp.zeros_like(dones_seq[:1]), dones_seq[:-1]], axis=0)
+            xs = (obs_seq, prev)
+        state, hs = jax.lax.scan(step, state, xs)
+        logits = mlp_apply(params["pi"], hs)
+        values = mlp_apply(params["vf"], hs)[..., 0]
+        return logits, values, state
