@@ -1261,6 +1261,23 @@ class Conductor:
         with self._lock:
             return list(self._task_events)
 
+    # Span ring (util/tracing.py sink; parity role:
+    # util/tracing/tracing_helper.py -> OTLP collector).
+    def rpc_push_spans(self, spans: List[dict]) -> None:
+        with self._lock:
+            if not hasattr(self, "_spans"):
+                self._spans: List[dict] = []
+            self._spans.extend(spans)
+            if len(self._spans) > 65536:
+                del self._spans[:len(self._spans) - 65536]
+
+    def rpc_get_spans(self, trace_id: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            spans = list(getattr(self, "_spans", ()))
+        if trace_id:
+            spans = [s for s in spans if s["trace_id"] == trace_id]
+        return spans
+
     def rpc_next_job_id(self) -> int:
         with self._lock:
             self._job_counter += 1

@@ -1280,6 +1280,20 @@ class NodeDaemon:
     def rpc_ping(self) -> str:
         return "pong"
 
+    def rpc_profile_worker(self, pid: int, duration_s: float = 1.0,
+                           interval_s: float = 0.01) -> Optional[str]:
+        """Profile the worker with this OS pid (None when the pid is not
+        one of ours). Parity: the dashboard agent's py-spy trigger,
+        reporter/profile_manager.py — here over the worker's RPC server."""
+        with self._lock:
+            target = next((w for w in self._workers.values()
+                           if w.pid == pid and w.address), None)
+        if target is None:
+            return None
+        return get_client(target.address).call(
+            "profile", duration_s=duration_s, interval_s=interval_s,
+            _timeout=float(duration_s) + 30.0)
+
     # ------------------------------------------------------------------
     def stop(self) -> None:
         self._stopped = True

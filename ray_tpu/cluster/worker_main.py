@@ -195,7 +195,8 @@ class WorkerService:
     # ------------------------------------------------------------------
     def _exec_one(self, task_id: bytes, function_id: str,
                   function_blob: Optional[bytes], args_blob: bytes,
-                  num_returns: int, name: str) -> None:
+                  num_returns: int, name: str,
+                  trace_ctx: Optional[dict] = None) -> None:
         """Execute one task body; returns are stored before this returns.
         Caller holds _exec_lock (serial normal-task execution)."""
         start = time.time()
@@ -214,7 +215,15 @@ class WorkerService:
         except BaseException as e:  # noqa: BLE001 - delivered via refs
             error = repr(e)
             self._fail_returns(task_id, num_returns, e, name)
-        self.events.record(task_id, name, "task", start, time.time(), error)
+        end = time.time()
+        self.events.record(task_id, name, "task", start, end, error)
+        if trace_ctx is not None:
+            from ray_tpu.util import tracing
+            ctx = tracing.new_context(parent=trace_ctx)
+            attrs = {"task": name, "worker_pid": os.getpid()}
+            if error:
+                attrs["error"] = error
+            tracing.record("task.execute", start, end, ctx, attrs)
 
     def rpc_push_task(self, task_id: bytes, function_id: str,
                       function_blob: Optional[bytes], args_blob: bytes,
@@ -232,8 +241,15 @@ class WorkerService:
             for t in tasks:
                 self._exec_one(t["task_id"], t["function_id"],
                                t.get("function_blob"), t["args_blob"],
-                               t["num_returns"], t.get("name", ""))
+                               t["num_returns"], t.get("name", ""),
+                               trace_ctx=t.get("trace_ctx"))
         self._flush_refs()
+        if any("trace_ctx" in t for t in tasks):
+            from ray_tpu import config
+            from ray_tpu.util import tracing
+            tracing.flush(get_client(
+                self.conductor_address,
+                reconnect_s=config.get("gcs_rpc_reconnect_s")))
         return {"ok": True}
 
     def rpc_cancel_task(self, task_id: bytes) -> None:
@@ -433,6 +449,14 @@ class WorkerService:
 
     def rpc_ping(self) -> str:
         return "pong"
+
+    def rpc_profile(self, duration_s: float = 1.0,
+                    interval_s: float = 0.01) -> str:
+        """On-demand sampling profile of this worker -> collapsed stacks
+        (util/profiler.py; parity: reporter/profile_manager.py py-spy)."""
+        from ray_tpu.util.profiler import collect
+        return collect(duration_s=min(float(duration_s), 30.0),
+                       interval_s=max(float(interval_s), 0.001))
 
     def rpc_exit(self) -> dict:
         self._release_taken_pins()

@@ -181,4 +181,7 @@ define("tpu_chips_per_host_override", int, 0, "0 = autodetect from jax.")
 
 # Observability
 define("task_event_buffer_size", int, 65536, "Task lifecycle events retained.")
+define("tracing_enabled", bool, False,
+       "Record OTel-style spans around task submit/execute "
+       "(util/tracing.py; read via state.list_spans).")
 define("metrics_export_period_s", float, 5.0, "Metrics flush period.")

@@ -385,7 +385,10 @@ class TaskSubmitter:
                         "function_id": r.task["function_id"],
                         "args_blob": r.task["args_blob"],
                         "num_returns": r.task["num_returns"],
-                        "name": r.task["name"]} for r in recs])
+                        "name": r.task["name"],
+                        **({"trace_ctx": r.task["trace_ctx"]}
+                           if "trace_ctx" in r.task else {})}
+                       for r in recs])
             for rec in recs:
                 rec.done = True
                 self._unpin_args(rec)
@@ -1112,6 +1115,18 @@ class ClusterRuntime:
             "key": (desc.function_id, tuple(sorted(resources.items())),
                     repr(strategy), _env_fingerprint(opts.runtime_env)),
         }
+        from ray_tpu.util import tracing
+        if tracing.enabled():
+            # Submit span (instant) + context propagated in the spec so
+            # the worker's execute span joins the same trace
+            # (tracing_helper.py role).
+            ctx = tracing.new_context()
+            now = __import__("time").time()
+            tracing.record("task.submit", now, now, ctx,
+                           {"task": task["name"],
+                            "task_id": task_id.hex()})
+            task["trace_ctx"] = ctx
+            tracing.flush(self.conductor)
         self.submitter.submit(task)
         return [ObjectRef(task_id.object_id_for_return(i), owner=self.address)
                 for i in range(opts.num_returns)]

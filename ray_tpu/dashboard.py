@@ -106,6 +106,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(dash.objects())
             elif path == "/api/events":
                 self._json(dash.events())
+            elif path == "/api/spans":
+                self._json(dash.spans())
+            elif path.startswith("/api/profile/"):
+                # /api/profile/<pid>?duration=2 -> collapsed stacks
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                dur = float(q.get("duration", ["2.0"])[0])
+                self._send(dash.profile(int(path.rsplit("/", 1)[-1]),
+                                        dur).encode(), "text/plain")
             elif path == "/metrics":
                 from ray_tpu.util.metrics import prometheus_text
                 self._send(prometheus_text().encode(), "text/plain")
@@ -168,6 +177,23 @@ class Dashboard:
 
     def events(self, limit: int = 500) -> list:
         return self._cli.call("list_events", limit=limit)
+
+    def spans(self) -> list:
+        return self._cli.call("get_spans")
+
+    def profile(self, pid: int, duration_s: float = 2.0) -> str:
+        for n in self._cli.call("get_nodes"):
+            if not n["alive"]:
+                continue
+            try:
+                dump = get_client(n["address"]).call(
+                    "profile_worker", pid=pid, duration_s=duration_s,
+                    _timeout=duration_s + 60.0)
+            except Exception:
+                continue
+            if dump is not None:
+                return dump
+        return f"no live worker with pid {pid}"
 
     def objects(self) -> list:
         out = []

@@ -146,8 +146,24 @@ def cmd_list(args) -> None:
     fn = {"actors": state.list_actors, "tasks": state.list_tasks,
           "nodes": state.list_nodes, "objects": state.list_objects,
           "placement-groups": state.list_placement_groups,
-          "events": state.list_cluster_events}[args.entity]
+          "events": state.list_cluster_events,
+          "spans": state.list_spans}[args.entity]
     print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_profile(args) -> None:
+    """`ray_tpu profile <pid>`: flamegraph-able stack dump of any live
+    worker (parity: `ray stack` / dashboard py-spy trigger)."""
+    _connect(args)
+    from ray_tpu import state
+    dump = state.profile_worker(args.pid, duration_s=args.duration)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(dump)
+        print(f"collapsed stacks written to {args.output} "
+              f"(feed to flamegraph.pl or speedscope)")
+    else:
+        print(dump)
 
 
 def cmd_summary(args) -> None:
@@ -347,9 +363,18 @@ def main(argv=None) -> None:
 
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument("entity", choices=["actors", "tasks", "nodes", "objects",
-                                      "placement-groups", "events"])
+                                      "placement-groups", "events",
+                                      "spans"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("profile",
+                       help="sample a worker's stacks (flamegraph input)")
+    p.add_argument("pid", type=int)
+    p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("--output", "-o", default=None)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_profile)
 
     args = parser.parse_args(argv)
     args.fn(args)

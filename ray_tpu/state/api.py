@@ -88,3 +88,30 @@ def list_cluster_events(limit: int = 1000, source: Optional[str] = None,
     kills, job state changes."""
     return _conductor().call("list_events", limit=limit, source=source,
                              severity=severity, event_type=event_type)
+
+
+def list_spans(trace_id: Optional[str] = None) -> List[dict]:
+    """Task-path spans (util/tracing.py; enable with
+    _system_config={"tracing_enabled": True}). Parity role:
+    util/tracing/tracing_helper.py span export."""
+    return _conductor().call("get_spans", trace_id=trace_id)
+
+
+def profile_worker(pid: int, duration_s: float = 1.0,
+                   interval_s: float = 0.01) -> str:
+    """Sample a worker's Python stacks anywhere in the cluster ->
+    collapsed-stack text (flamegraph.pl / speedscope input). Parity:
+    `ray stack` / the dashboard's py-spy trigger."""
+    from ray_tpu.cluster.protocol import get_client
+    for n in _conductor().call("get_nodes"):
+        if not n["alive"]:
+            continue
+        try:
+            dump = get_client(n["address"]).call(
+                "profile_worker", pid=pid, duration_s=duration_s,
+                interval_s=interval_s, _timeout=duration_s + 60.0)
+        except Exception:
+            continue
+        if dump is not None:
+            return dump
+    raise ValueError(f"no live worker with pid {pid} in the cluster")

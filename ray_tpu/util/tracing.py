@@ -1,0 +1,94 @@
+"""OTel-style spans for the task path.
+
+Role parity: python/ray/util/tracing/tracing_helper.py — the reference
+wraps remote-call submission and worker-side execution in OpenTelemetry
+spans and propagates the trace context inside the task spec. Same shape
+here without the otel dependency: spans are plain dicts
+{trace_id, span_id, parent_id, name, start, end, attrs}, the context
+rides the task dict ("trace_ctx"), and finished spans buffer locally
+until flushed to the conductor's span ring (state.list_spans / the
+dashboard read them; export to a real OTLP collector is a sink swap).
+
+Enabled via the `tracing_enabled` flag (env RAY_TPU_TRACING_ENABLED=1 or
+init(_system_config={"tracing_enabled": True})). Off = zero overhead on
+the hot path beyond one flag read.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_buffer: List[dict] = []
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    from ray_tpu import config
+    return bool(config.get("tracing_enabled"))
+
+
+def new_context(parent: Optional[dict] = None) -> dict:
+    """A fresh span context; child of ``parent`` when given."""
+    return {
+        "trace_id": (parent or {}).get("trace_id") or uuid.uuid4().hex,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": (parent or {}).get("span_id"),
+    }
+
+
+def record(name: str, start: float, end: float, ctx: dict,
+           attrs: Optional[Dict[str, Any]] = None) -> None:
+    with _lock:
+        _buffer.append({
+            "name": name, "start": start, "end": end,
+            "trace_id": ctx["trace_id"], "span_id": ctx["span_id"],
+            "parent_id": ctx.get("parent_id"),
+            "attrs": dict(attrs or {}),
+        })
+        if len(_buffer) > 65536:
+            del _buffer[:len(_buffer) - 65536]
+
+
+@contextlib.contextmanager
+def span(name: str, parent: Optional[dict] = None,
+         attrs: Optional[Dict[str, Any]] = None):
+    """Context manager: times the body, records on exit, yields the span
+    context for propagation (stick it in the task dict)."""
+    if not enabled():
+        yield None
+        return
+    ctx = new_context(parent)
+    start = time.time()
+    error = None
+    try:
+        yield ctx
+    except BaseException as e:  # noqa: BLE001 - annotated and re-raised
+        error = repr(e)
+        raise
+    finally:
+        a = dict(attrs or {})
+        if error:
+            a["error"] = error
+        record(name, start, time.time(), ctx, a)
+
+
+def drain() -> List[dict]:
+    with _lock:
+        out, _buffer[:] = list(_buffer), []
+    return out
+
+
+def flush(conductor_client) -> None:
+    """Ship buffered spans to the conductor ring; re-buffers on failure."""
+    spans = drain()
+    if not spans:
+        return
+    try:
+        conductor_client.call("push_spans", spans=spans)
+    except Exception:
+        with _lock:
+            _buffer[:0] = spans  # retry on the next flush
