@@ -147,6 +147,24 @@ def main(argv=None) -> int:
         per, _ = timed(actor_async, min_time=2.0 * scale, min_iters=2)
         results["actor_calls_async_per_sec"] = round(n_calls / per, 1)
 
+        # -- actor creation throughput (zygote fork path) -------------
+        # End-to-end: N actors created, first method call acked, killed.
+        # Fractional CPUs so the 4-CPU cluster holds the whole cohort.
+        settle()
+        LightCounter = Counter.options(num_cpus=0.05)
+        n_act = int(40 * scale) or 8
+
+        def actor_create():
+            actors = [LightCounter.remote() for _ in range(n_act)]
+            ray_tpu.get([x.incr.remote() for x in actors])
+            for x in actors:
+                ray_tpu.kill(x)
+
+        per, _ = timed(actor_create, min_time=2.0 * scale, min_iters=2)
+        results["actor_creation_per_sec"] = round(n_act / per, 1)
+        results["host_cpus"] = os.cpu_count()  # creation is CPU-bound:
+        # fork + worker boot + RPCs parallelize across cores on real hosts
+
         # -- wait over many refs --------------------------------------
         settle()
         refs = [ray_tpu.put(i) for i in range(1000)]

@@ -108,7 +108,9 @@ class _ForkedProc:
         if self._pidfd >= 0:
             try:
                 os.close(self._pidfd)
-            except OSError:
+            except Exception:
+                # OSError, or AttributeError/TypeError during interpreter
+                # shutdown (the os module may already be torn down).
                 pass
             self._pidfd = -1
 

@@ -69,6 +69,15 @@ def main() -> None:
     import ray_tpu.core.runtime_cluster  # noqa: F401
     import ray_tpu.cluster.worker_main as worker_main
 
+    # Freeze the imported object graph into the permanent GC generation:
+    # children never traverse it, so refcount/gc writes stop COW-faulting
+    # the ~170MB of pre-imported module pages (the CPython zygote trick,
+    # gc.freeze's documented purpose). Measurably cuts per-fork CPU on
+    # single-core hosts and RSS growth everywhere.
+    import gc
+    gc.collect()
+    gc.freeze()
+
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # kernel reaps children
     srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     try:
