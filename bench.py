@@ -86,13 +86,13 @@ def bench_lm(seq: int = 2048, batch_per_chip: int = 8) -> dict:
     from ray_tpu.parallel import MeshSpec, build_mesh
     from ray_tpu.train import make_lm_train_step
 
-    try:  # one-time on-chip block tuning for this sequence length
+    n = jax.device_count()
+    try:  # one-time on-chip block tuning at the REAL workload shape
         from ray_tpu.ops.flash import autotune_blocks
-        autotune_blocks(seq)
+        autotune_blocks(seq, head_dim=2048 // 16, heads=16,
+                        batch=batch_per_chip * n)
     except Exception:  # noqa: BLE001 - fall back to the static table
         pass
-
-    n = jax.device_count()
     # ~0.74B params: the largest llama-style config whose f32 params + adam
     # moments + f32 grads (16 bytes/param) plus activations fit a 16G v5e
     # chip with per-layer remat. batch_per_chip*seq is held at 16k tokens
