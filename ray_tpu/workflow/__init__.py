@@ -1,13 +1,18 @@
 """ray_tpu.workflow — durable DAG execution.
 
 Parity surface: reference python/ray/workflow (workflow_executor.py,
-workflow_state_from_storage.py): run a DAG of tasks where every step's
-result is checkpointed to storage; a crashed/resumed workflow skips
-completed steps and recomputes only the rest.
+workflow_state_from_storage.py, workflow_storage.py, the event system):
+run a DAG of tasks where every step's result is checkpointed to
+pluggable storage; a crashed/resumed workflow skips completed steps and
+recomputes only the rest. Steps may return ``continuation(sub_dag)``
+(dynamic workflows) and wait on externally-delivered ``event``s.
 """
 
-from ray_tpu.workflow.execution import (delete, get_output, get_status,
-                                        list_all, resume, run, run_async)
+from ray_tpu.workflow.execution import (continuation, delete, event,
+                                        get_output, get_status, list_all,
+                                        resume, run, run_async, send_event,
+                                        set_storage)
 
 __all__ = ["run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "delete"]
+           "list_all", "delete", "continuation", "event", "send_event",
+           "set_storage"]

@@ -1,36 +1,55 @@
-"""Durable DAG executor.
+"""Durable DAG executor: static DAGs, dynamic continuations, events.
 
 Each DAG node becomes a *step* with a deterministic step-id (the node's
 position in a post-order walk + function name). Before running a step the
 executor checks storage; a hit short-circuits the whole subtree (parity:
 workflow_state_from_storage.py recovery semantics). Results persist as
-pickle files under <storage>/<workflow_id>/steps/.
+pickle blobs behind the pluggable storage interface (storage.py; parity:
+workflow_storage.py).
+
+Dynamic workflows (parity: workflow_executor.py continuation handling):
+a step may return ``workflow.continuation(sub_dag)`` — the sub-DAG
+replaces the step, executing durably with step-ids namespaced under the
+parent, and its result becomes the step's checkpointed result. Recursion
+through continuations expresses loops/recursion the static DAG cannot.
+
+Events (parity: python/ray/workflow event system): ``workflow.event(n)``
+is a step that completes only once ``workflow.send_event(workflow_id, n,
+payload)`` delivers a payload through storage — so a resumed workflow
+sees an already-delivered event without re-waiting.
 """
 
 from __future__ import annotations
 
-import os
 import pickle
-import shutil
-import tempfile
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.dag.nodes import DAGNode, FunctionNode, InputNode
-
-_DEFAULT_STORAGE = os.path.join(tempfile.gettempdir(), "rtpu_workflows")
-_storage_root = os.environ.get("RTPU_WORKFLOW_STORAGE", _DEFAULT_STORAGE)
+from ray_tpu.workflow.storage import get_storage, set_storage  # noqa: F401
 
 
-def _wf_dir(workflow_id: str) -> str:
-    return os.path.join(_storage_root, workflow_id)
+class Continuation:
+    """Wrapper a step returns to hand control to a sub-DAG."""
+
+    def __init__(self, dag: DAGNode, input_value: Any = None):
+        if not isinstance(dag, DAGNode):
+            raise TypeError("continuation() takes a bound DAG node "
+                            "(fn.bind(...))")
+        self.dag = dag
+        self.input_value = input_value
 
 
-def _step_path(workflow_id: str, step_id: str) -> str:
-    return os.path.join(_wf_dir(workflow_id), "steps", f"{step_id}.pkl")
+def continuation(dag: DAGNode, input_value: Any = None) -> Continuation:
+    return Continuation(dag, input_value)
 
 
-def _assign_step_ids(dag: DAGNode) -> Dict[int, str]:
+def _step_key(workflow_id: str, step_id: str) -> str:
+    return f"{workflow_id}/steps/{step_id}.pkl"
+
+
+def _assign_step_ids(dag: DAGNode, prefix: str = "") -> Dict[int, str]:
     """Deterministic ids: post-order index + callable name."""
     order: List[DAGNode] = []
     seen = set()
@@ -51,24 +70,28 @@ def _assign_step_ids(dag: DAGNode) -> Dict[int, str]:
             name = getattr(node._remote_fn, "__name__", "fn")
         elif isinstance(node, InputNode):
             name = "input"
-        ids[id(node)] = f"{i:04d}_{name}"
+        ids[id(node)] = f"{prefix}{i:04d}_{name}"
     return ids
+
+
+def _checkpoint(workflow_id: str, step_id: str, value: Any) -> None:
+    get_storage().put_bytes(_step_key(workflow_id, step_id),
+                            pickle.dumps(value, protocol=5))
 
 
 def _execute_durable(node: DAGNode, workflow_id: str,
                      step_ids: Dict[int, str], memo: Dict[int, Any],
                      input_value) -> Any:
     import ray_tpu as rt
-    from ray_tpu.core.refs import ObjectRef
 
+    store = get_storage()
     key = id(node)
     if key in memo:
         return memo[key]
     step_id = step_ids[key]
-    path = _step_path(workflow_id, step_id)
-    if os.path.exists(path):
-        with open(path, "rb") as f:
-            out = pickle.load(f)
+    skey = _step_key(workflow_id, step_id)
+    if store.exists(skey):
+        out = pickle.loads(store.get_bytes(skey))
         memo[key] = out
         return out
     if isinstance(node, InputNode):
@@ -86,27 +109,99 @@ def _execute_durable(node: DAGNode, workflow_id: str,
             raise TypeError(
                 f"workflow DAGs support function nodes and InputNode; got "
                 f"{type(node).__name__} (actor nodes are not durable)")
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(out, f, protocol=5)
-    os.replace(tmp, path)  # atomic commit of the step checkpoint
+        if isinstance(out, Continuation):
+            # Dynamic workflow: the sub-DAG replaces this step. Its own
+            # steps checkpoint under a namespaced prefix, so resume
+            # re-enters the continuation and skips its finished parts.
+            sub_ids = _assign_step_ids(out.dag, prefix=f"{step_id}.c/")
+            out = _execute_durable(out.dag, workflow_id, sub_ids, {},
+                                   out.input_value)
+    _checkpoint(workflow_id, step_id, out)
     memo[key] = out
     return out
 
 
+# ---------------------------------------------------------------------------
+# events
+
+
+def _event_key(workflow_id: str, name: str) -> str:
+    return f"{workflow_id}/events/{name}.pkl"
+
+
+def send_event(workflow_id: str, name: str, payload: Any = None) -> None:
+    """Deliver an external event through storage; the waiting step (and
+    any resumed re-run) observes it durably."""
+    get_storage().put_bytes(_event_key(workflow_id, name),
+                            pickle.dumps(payload, protocol=5))
+
+
+def _wait_event_fn(workflow_id: str, name: str, timeout_s: Optional[float],
+                   poll_s: float, storage_url: str):
+    # Runs in a WORKER: the driver's storage selection doesn't exist
+    # here, so the step carries the URL.
+    from ray_tpu.workflow.storage import storage_for
+    store = storage_for(storage_url)
+    k = _event_key(workflow_id, name)
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    while True:
+        if store.exists(k):
+            return pickle.loads(store.get_bytes(k))
+        if deadline is not None and time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"workflow event {name!r} not delivered in {timeout_s}s")
+        time.sleep(poll_s)
+
+
+def event(name: str, *, timeout_s: Optional[float] = None,
+          poll_s: float = 0.2) -> DAGNode:
+    """A DAG step that completes when ``send_event(workflow_id, name)``
+    delivers a payload; evaluates to that payload. The workflow id is
+    injected at run() time."""
+    import ray_tpu as rt
+
+    from ray_tpu.workflow.storage import get_storage_url
+    fn = rt.remote(_wait_event_fn).options(num_cpus=0.01)
+    node = fn.bind(_WorkflowIdPlaceholder(), name, timeout_s, poll_s,
+                   get_storage_url())
+    return node
+
+
+class _WorkflowIdPlaceholder:
+    """Replaced with the actual workflow id when run() walks the DAG."""
+
+
+def _inject_workflow_id(dag: DAGNode, workflow_id: str) -> None:
+    seen = set()
+
+    def walk(node: DAGNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        node._bound_args = tuple(
+            workflow_id if isinstance(a, _WorkflowIdPlaceholder) else a
+            for a in node._bound_args)
+        node._bound_kwargs = {
+            k: workflow_id if isinstance(v, _WorkflowIdPlaceholder) else v
+            for k, v in node._bound_kwargs.items()}
+        for child in node._children():
+            walk(child)
+
+    walk(dag)
+
+
+# ---------------------------------------------------------------------------
+# workflow lifecycle
+
+
 def _set_status(workflow_id: str, status: str, dag_blob: Optional[bytes],
                 input_blob: Optional[bytes] = None) -> None:
-    d = _wf_dir(workflow_id)
-    os.makedirs(d, exist_ok=True)
-    with open(os.path.join(d, "status"), "w") as f:
-        f.write(status)
+    store = get_storage()
+    store.put_bytes(f"{workflow_id}/status", status.encode())
     if dag_blob is not None:
-        with open(os.path.join(d, "dag.pkl"), "wb") as f:
-            f.write(dag_blob)
+        store.put_bytes(f"{workflow_id}/dag.pkl", dag_blob)
     if input_blob is not None:
-        with open(os.path.join(d, "input.pkl"), "wb") as f:
-            f.write(input_blob)
+        store.put_bytes(f"{workflow_id}/input.pkl", input_blob)
 
 
 def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
@@ -116,6 +211,7 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
 
     import cloudpickle
     workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:8]}"
+    _inject_workflow_id(dag, workflow_id)
     _set_status(workflow_id, "RUNNING", cloudpickle.dumps(dag),
                 cloudpickle.dumps(input_value))
     step_ids = _assign_step_ids(dag)
@@ -124,8 +220,8 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     except BaseException:
         _set_status(workflow_id, "FAILED", None)
         raise
-    with open(os.path.join(_wf_dir(workflow_id), "output.pkl"), "wb") as f:
-        pickle.dump(out, f, protocol=5)
+    get_storage().put_bytes(f"{workflow_id}/output.pkl",
+                            pickle.dumps(out, protocol=5))
     _set_status(workflow_id, "SUCCESSFUL", None)
     return out
 
@@ -150,34 +246,30 @@ def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
 def resume(workflow_id: str) -> Any:
     """Re-run a stored workflow; completed steps are read from storage."""
     import cloudpickle
-    d = _wf_dir(workflow_id)
-    with open(os.path.join(d, "dag.pkl"), "rb") as f:
-        dag = cloudpickle.load(f)
+    store = get_storage()
+    dag = cloudpickle.loads(store.get_bytes(f"{workflow_id}/dag.pkl"))
     input_value = None
-    input_path = os.path.join(d, "input.pkl")
-    if os.path.exists(input_path):
-        with open(input_path, "rb") as f:
-            input_value = cloudpickle.load(f)
+    if store.exists(f"{workflow_id}/input.pkl"):
+        input_value = cloudpickle.loads(
+            store.get_bytes(f"{workflow_id}/input.pkl"))
     return run(dag, workflow_id=workflow_id, input_value=input_value)
 
 
 def get_output(workflow_id: str) -> Any:
-    with open(os.path.join(_wf_dir(workflow_id), "output.pkl"), "rb") as f:
-        return pickle.load(f)
+    return pickle.loads(get_storage().get_bytes(f"{workflow_id}/output.pkl"))
 
 
 def get_status(workflow_id: str) -> str:
-    path = os.path.join(_wf_dir(workflow_id), "status")
-    if not os.path.exists(path):
+    store = get_storage()
+    if not store.exists(f"{workflow_id}/status"):
         return "NOT_FOUND"
-    return open(path).read().strip()
+    return store.get_bytes(f"{workflow_id}/status").decode().strip()
 
 
 def list_all() -> List[tuple]:
-    if not os.path.isdir(_storage_root):
-        return []
-    return [(wf, get_status(wf)) for wf in sorted(os.listdir(_storage_root))]
+    store = get_storage()
+    return [(wf, get_status(wf)) for wf in store.list_prefix("")]
 
 
 def delete(workflow_id: str) -> None:
-    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+    get_storage().delete_prefix(workflow_id)

@@ -81,7 +81,7 @@ def test_actor_dag(cluster):
 def test_workflow_durable_resume(cluster, tmp_path):
     from ray_tpu import workflow
     from ray_tpu.workflow import execution
-    execution._storage_root = str(tmp_path)
+    workflow.set_storage(str(tmp_path))
     from ray_tpu.dag import InputNode
 
     marker = str(tmp_path / "exec_count")
@@ -115,7 +115,7 @@ def test_workflow_durable_resume(cluster, tmp_path):
 def test_workflow_failure_then_resume(cluster, tmp_path):
     from ray_tpu import workflow
     from ray_tpu.workflow import execution
-    execution._storage_root = str(tmp_path)
+    workflow.set_storage(str(tmp_path))
     from ray_tpu.dag import InputNode
 
     flag = str(tmp_path / "ok")
