@@ -1145,6 +1145,12 @@ class NodeDaemon:
     def rpc_start_job(self, submission_id: str, entrypoint: str,
                       runtime_env: Optional[dict],
                       conductor_address: str) -> dict:
+        # Idempotent by submission id: the client retries dispatch
+        # at-least-once (a lost ACK must not double-start the entrypoint).
+        with self._lock:
+            existing = self._jobs.get(submission_id)
+        if existing is not None:
+            return {"ok": True, "log_path": existing["log"]}
         log_path = os.path.join(self.session_dir,
                                 f"job-{submission_id}.log")
         env = dict(os.environ)

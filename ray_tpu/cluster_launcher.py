@@ -112,11 +112,15 @@ def run_head_session(config_path: str) -> None:
     os.makedirs(session_dir, exist_ok=True)
     # No journal recovery here: every `up` is a NEW cluster, and a journal
     # from a previous same-port cluster would resurrect its dead node
-    # entries (get_nodes would then hand `submit` a dead head address).
-    # Same-port failover belongs to `start --head`, not the launcher.
-    import shutil
-    shutil.rmtree(os.path.join(session_dir, "conductor"),
-                  ignore_errors=True)
+    # entries as briefly-"alive" (until the health timeout), handing
+    # `submit` a dead head address. Same-port failover belongs to
+    # `start --head`, not the launcher. The journal is the
+    # conductor.log/.snap file pair (persistence.py StateJournal).
+    for suffix in (".log", ".snap", ".snap.tmp"):
+        try:
+            os.unlink(os.path.join(session_dir, "conductor" + suffix))
+        except OSError:
+            pass
     conductor = Conductor(host=head.get("host", "127.0.0.1"), port=port,
                           persist_dir=session_dir)
     daemon = NodeDaemon(conductor.address,

@@ -92,9 +92,32 @@ class JobSubmissionClient:
         self._conductor.call("kv_put", ns=JOBS_NS,
                              key=submission_id.encode(),
                              value=pickle.dumps(rec), overwrite=False)
-        get_client(node["address"]).call(
-            "start_job", submission_id=submission_id, entrypoint=entrypoint,
-            runtime_env=runtime_env, conductor_address=self._address)
+        # Retry transient dispatch failures against a fresh node pick: the
+        # chosen daemon may be a not-yet-health-timed-out corpse or
+        # briefly unreachable on a loaded host. The job record is already
+        # durable in KV and the daemon dedupes start_job by id, so
+        # at-least-once dispatch is safe. The record's node_id follows
+        # the node that ACTUALLY took the job (log lookups key on it).
+        deadline = time.time() + 30.0
+        while True:
+            try:
+                get_client(node["address"]).call(
+                    "start_job", submission_id=submission_id,
+                    entrypoint=entrypoint, runtime_env=runtime_env,
+                    conductor_address=self._address)
+                break
+            except (ConnectionError, OSError):
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.5)
+                node = self._head_daemon()
+        if node["node_id"].hex() != rec["node_id"]:
+            # Re-read first: the daemon may have already bumped status.
+            cur = self._record(submission_id)
+            cur["node_id"] = node["node_id"].hex()
+            self._conductor.call("kv_put", ns=JOBS_NS,
+                                 key=submission_id.encode(),
+                                 value=pickle.dumps(cur), overwrite=True)
         return submission_id
 
     def get_job_status(self, submission_id: str) -> str:

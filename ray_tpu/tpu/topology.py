@@ -152,7 +152,29 @@ def generation(default: str = "v5e") -> str:
 _generation_cache: Optional[str] = None
 
 
+def platform_pinned_off_tpu() -> bool:
+    """True when this process is explicitly pinned to a non-TPU platform
+    (JAX_PLATFORMS env or the jax_platforms config knob). Probing the TPU
+    backend anyway would INITIALIZE it — and on a host whose TPU
+    plugin/tunnel is wedged, that init blocks indefinitely. A process
+    that said "cpu" must never touch the chip (the round-4 example
+    timeouts were drivers pinned to cpu hanging exactly here)."""
+    import os
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    try:
+        import jax
+        cfg = getattr(jax.config, "jax_platforms", None) or ""
+        if cfg:
+            plats = cfg
+    except Exception:  # noqa: BLE001 - jax not importable: no TPU either
+        return True
+    plats = [p.strip() for p in plats.split(",") if p.strip()]
+    return bool(plats) and "tpu" not in plats and "axon" not in plats
+
+
 def local_chip_count() -> int:
+    if platform_pinned_off_tpu():
+        return 0
     import jax
     return jax.local_device_count()
 

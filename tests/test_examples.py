@@ -19,13 +19,23 @@ _serial = threading.Lock()
 
 
 def _run(name, timeout=300):
-    timeout = timeout * max(1, 4 // max(os.cpu_count() or 1, 1))
+    timeout = timeout * min(2, max(1, 4 // max(os.cpu_count() or 1, 1)))
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # Self-diagnosing on hang: dump all thread stacks to stderr (captured
+    # below) and exit shortly before the subprocess timeout would strike,
+    # so a wedge fails WITH a stack instead of a bare TimeoutExpired.
+    wrapper = (
+        "import faulthandler, runpy, sys;"
+        f"faulthandler.dump_traceback_later({timeout - 15}, exit=True);"
+        f"sys.argv=[{name!r}];"
+        f"runpy.run_path({os.path.join(REPO, 'examples', name)!r}, "
+        "run_name='__main__')"
+    )
     with _serial:
         out = subprocess.run(
-            [sys.executable, os.path.join(REPO, "examples", name)],
+            [sys.executable, "-c", wrapper],
             capture_output=True, text=True, timeout=timeout, env=env,
             cwd=REPO)
     assert out.returncode == 0, f"{name} failed:\n{out.stdout}\n{out.stderr}"
