@@ -185,9 +185,12 @@ class StandardAutoscaler:
                         break
                     self.provider.create_node(tname)
                     launched[tname] = launched.get(tname, 0) + 1
-        if len(workers) < self.max_workers:
+        if len(workers) + sum(launched.values()) < self.max_workers:
             # per-type caps are cluster-wide: subtract what already runs
-            existing: Dict[str, int] = {}
+            # AND what the replenish loop above just launched (those nodes
+            # aren't in non_terminated_nodes() yet; ignoring them lets one
+            # reconcile pass overshoot max_workers / per-type caps).
+            existing: Dict[str, int] = dict(launched)
             for _, t in workers:
                 existing[t] = existing.get(t, 0) + 1
             caps = {t: max(0, cfg.get("max_workers", 10) -

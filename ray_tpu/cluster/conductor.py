@@ -265,12 +265,10 @@ class Conductor:
             if info is not None:
                 info["alive"] = False
         elif kind == "actor":
-            a = ActorInfo(data["actor_id"], data["spec"])
-            self._actors[a.actor_id] = a
-            name = a.spec["opts"].get("name") or ""
-            ns = a.spec["opts"].get("namespace") or "default"
-            if name:
-                self._named_actors[(ns, name)] = a.actor_id
+            self._replay_actor(data)
+        elif kind == "actors":
+            for rec in data["items"]:
+                self._replay_actor(rec)
         elif kind == "actor_state":
             a = self._actors.get(data["actor_id"])
             if a is not None:
@@ -292,12 +290,23 @@ class Conductor:
             self._pgs.pop(data["pg_id"], None)
         elif kind == "kv":
             self._kv[(data["ns"], data["key"])] = data["value"]
+        elif kind == "kv_batch":
+            for rec in data["items"]:
+                self._kv[(rec["ns"], rec["key"])] = rec["value"]
         elif kind == "kv_del":
             self._kv.pop((data["ns"], data["key"]), None)
         elif kind == "fn":
             self._functions[data["function_id"]] = data["blob"]
         elif kind == "job":
             self._job_counter = data["counter"]
+
+    def _replay_actor(self, data: dict) -> None:
+        a = ActorInfo(data["actor_id"], data["spec"])
+        self._actors[a.actor_id] = a
+        name = a.spec["opts"].get("name") or ""
+        ns = a.spec["opts"].get("namespace") or "default"
+        if name:
+            self._named_actors[(ns, name)] = a.actor_id
 
     def _maybe_compact(self) -> None:
         if not self._compact_due or self._journal is None or self._stopped:
@@ -510,6 +519,27 @@ class Conductor:
             self._log("kv", {"ns": ns, "key": key, "value": value})
             self._cv.notify_all()
         return True
+
+    def rpc_kv_multi_put(self, items: List[tuple],
+                         overwrite: bool = True) -> List[bool]:
+        """Coalesced KV writes: one lock acquisition + ONE journal record
+        for N (ns, key, value) triples — a wave of writes costs O(1)
+        round-trips and fsyncs instead of O(N) (parity: the reference's
+        InternalKVMultiSet batching)."""
+        out: List[bool] = []
+        logged: List[dict] = []
+        with self._cv:
+            for ns, key, value in items:
+                if not overwrite and (ns, key) in self._kv:
+                    out.append(False)
+                    continue
+                self._kv[(ns, key)] = value
+                logged.append({"ns": ns, "key": key, "value": value})
+                out.append(True)
+            if logged:
+                self._log("kv_batch", {"items": logged})
+                self._cv.notify_all()
+        return out
 
     def rpc_kv_get(self, ns: str, key: bytes,
                    wait_timeout: float = 0.0) -> Optional[bytes]:
@@ -775,29 +805,57 @@ class Conductor:
     # gcs_actor_scheduler.h:111 ScheduleByRaylet mode)
     # ------------------------------------------------------------------
     def rpc_register_actor(self, actor_id: bytes, spec: dict) -> dict:
-        name = spec["opts"].get("name") or ""
-        ns = spec["opts"].get("namespace") or "default"
+        out = self.rpc_register_actors(
+            [{"actor_id": actor_id, "spec": spec}])[0]
+        if out.get("error"):
+            raise ValueError(out["error"])
+        return out
+
+    def rpc_register_actors(self, items: List[dict]) -> List[dict]:
+        """Wave registration: one lock acquisition + ONE journal record for
+        N actors (parity: Ray's async batched GCS actor registration;
+        perf pointer python/ray/_private/ray_perf.py). Each item is
+        {"actor_id", "spec"}; the reply aligns with the request — per-item
+        "existing" (dedup/get_if_exists hit) or "error" (name collision,
+        raised by the single-actor shim, reported in-band here so one bad
+        name cannot fail a whole wave)."""
+        results: List[Optional[dict]] = [None] * len(items)
+        to_schedule: List[bytes] = []
+        logged: List[dict] = []
         with self._cv:
-            if actor_id in self._actors:
-                # At-least-once delivery (reconnecting client resent after
-                # a lost response): actor ids are caller-generated, so a
-                # duplicate IS the same creation — ack it, don't collide
-                # on the name.
-                return {"existing": None}
-            if name:
-                existing = self._named_actors.get((ns, name))
-                if existing is not None and \
-                        self._actors[existing].state != DEAD:
-                    if spec["opts"].get("get_if_exists"):
-                        return {"existing": existing}
-                    raise ValueError(
-                        f"Actor name {name!r} already taken in namespace {ns!r}")
-                self._named_actors[(ns, name)] = actor_id
-            self._actors[actor_id] = ActorInfo(actor_id, spec)
-            self._log("actor", {"actor_id": actor_id, "spec": spec})
-            self._cv.notify_all()
-        self._schedule_actor(actor_id)
-        return {"existing": None}
+            for i, item in enumerate(items):
+                actor_id, spec = item["actor_id"], item["spec"]
+                name = spec["opts"].get("name") or ""
+                ns = spec["opts"].get("namespace") or "default"
+                if actor_id in self._actors:
+                    # At-least-once delivery (reconnecting client resent
+                    # after a lost response): actor ids are caller-
+                    # generated, so a duplicate IS the same creation — ack
+                    # it, don't collide on the name.
+                    results[i] = {"existing": None}
+                    continue
+                if name:
+                    existing = self._named_actors.get((ns, name))
+                    if existing is not None and \
+                            self._actors[existing].state != DEAD:
+                        if spec["opts"].get("get_if_exists"):
+                            results[i] = {"existing": existing}
+                        else:
+                            results[i] = {
+                                "existing": None,
+                                "error": f"Actor name {name!r} already "
+                                         f"taken in namespace {ns!r}"}
+                        continue
+                    self._named_actors[(ns, name)] = actor_id
+                self._actors[actor_id] = ActorInfo(actor_id, spec)
+                logged.append({"actor_id": actor_id, "spec": spec})
+                to_schedule.append(actor_id)
+                results[i] = {"existing": None}
+            if logged:
+                self._log("actors", {"items": logged})
+                self._cv.notify_all()
+        self._schedule_actors(to_schedule)
+        return results
 
     def _pick_node_for(self, resources: Dict[str, float],
                        strategy: Any = None) -> Optional[dict]:
@@ -860,29 +918,45 @@ class Conductor:
         return dict(best) if best else None
 
     def _schedule_actor(self, actor_id: bytes) -> None:
-        with self._lock:
-            a = self._actors.get(actor_id)
-            if a is None or a.state == DEAD:
-                return
-            spec = a.spec
-        node = self._pick_node_for(spec["opts"].get("resources_req", {"CPU": 1.0}),
-                                   spec["opts"].get("scheduling_strategy"))
-        if node is None:
-            # No feasible node now: retry when membership/resources change.
-            threading.Timer(0.2, self._schedule_actor, args=(actor_id,)).start()
-            return
-        with self._lock:
-            a = self._actors.get(actor_id)
-            if a is None or a.state == DEAD:
-                return
-            a.node_id = node["node_id"]
-            incarnation = a.incarnation
-        try:
-            get_client(node["address"]).call(
-                "start_actor", actor_id=actor_id, spec=spec,
-                incarnation=incarnation)
-        except Exception as e:  # node unreachable -> mark dead, reschedule
-            self._mark_node_dead(node["node_id"], f"unreachable: {e}")
+        self._schedule_actors([actor_id])
+
+    def _schedule_actors(self, actor_ids: List[bytes]) -> None:
+        """Place a wave of actors: node picks happen in one pass, then the
+        conductor sends ONE ``start_actors`` RPC per target daemon instead
+        of one ``start_actor`` per actor (the round-5 profile pinned wave
+        collapse on exactly these serialized per-actor round-trips)."""
+        by_node: Dict[str, List[dict]] = {}
+        node_of: Dict[str, bytes] = {}
+        for actor_id in actor_ids:
+            with self._lock:
+                a = self._actors.get(actor_id)
+                if a is None or a.state == DEAD:
+                    continue
+                spec = a.spec
+            node = self._pick_node_for(
+                spec["opts"].get("resources_req", {"CPU": 1.0}),
+                spec["opts"].get("scheduling_strategy"))
+            if node is None:
+                # No feasible node now: retry when membership/resources
+                # change.
+                threading.Timer(0.2, self._schedule_actor,
+                                args=(actor_id,)).start()
+                continue
+            with self._lock:
+                a = self._actors.get(actor_id)
+                if a is None or a.state == DEAD:
+                    continue
+                a.node_id = node["node_id"]
+                incarnation = a.incarnation
+            by_node.setdefault(node["address"], []).append(
+                {"actor_id": actor_id, "spec": spec,
+                 "incarnation": incarnation})
+            node_of[node["address"]] = node["node_id"]
+        for addr, batch in by_node.items():
+            try:
+                get_client(addr).call("start_actors", items=batch)
+            except Exception as e:  # node unreachable -> mark dead
+                self._mark_node_dead(node_of[addr], f"unreachable: {e}")
 
     def rpc_actor_started(self, actor_id: bytes, address: str,
                           node_id: bytes, incarnation: int) -> None:
@@ -995,6 +1069,42 @@ class Conductor:
                 if remaining <= 0:
                     return self._actor_info_of(a)
                 self._cv.wait(min(remaining, 1.0))
+
+    def rpc_get_actor_infos(self, actor_ids: List[bytes],
+                            wait_alive_timeout: float = 0.0) -> List[dict]:
+        """Batched get_actor_info: ONE long-poll covers a whole wave (the
+        driver-side shared resolver multiplexes every pending actor of a
+        process into this). Returns as soon as any actor newly leaves
+        PENDING/RESTARTING — the caller unblocks what resolved and re-polls
+        for the rest — or at the timeout. Unregistered ids report UNKNOWN
+        but keep the poll alive: with driver-side registration coalescing a
+        wave member may be an in-flight register away."""
+        deadline = time.monotonic() + wait_alive_timeout
+
+        def snapshot():
+            infos, resolved = [], 0
+            for aid in actor_ids:
+                a = self._actors.get(aid)
+                if a is None:
+                    infos.append({"state": "UNKNOWN"})
+                else:
+                    infos.append(self._actor_info_of(a))
+                    if a.state in (ALIVE, DEAD):
+                        resolved += 1
+            return infos, resolved
+
+        with self._cv:
+            infos, baseline = snapshot()
+            if wait_alive_timeout <= 0 or baseline == len(actor_ids):
+                return infos
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return infos
+                self._cv.wait(min(remaining, 1.0))
+                infos, resolved = snapshot()
+                if resolved > baseline or resolved == len(actor_ids):
+                    return infos
 
     @staticmethod
     def _actor_info_of(a: "ActorInfo") -> dict:

@@ -60,6 +60,51 @@ def main(argv=None) -> int:
     scale = 0.2 if args.quick else 1.0
     results: dict = {}
 
+    # -- raw RPC framing: serialized vs pipelined frames --------------
+    # Measured OUTSIDE the cluster so the number isolates the wire/frame
+    # cost (one in-flight call per socket vs sequence-numbered frames).
+    from ray_tpu.cluster.protocol import RpcClient, RpcServer
+
+    class _Echo:
+        def rpc_echo(self, x):
+            return x
+
+        def rpc_echo_1ms(self, x):
+            # Stand-in for real service time (lock contention, disk,
+            # downstream RPC). Pipelining only pays off when the server
+            # does WORK per call — on a zero-latency loopback the extra
+            # executor handoff makes pipelined frames slower, so the
+            # headline comparison injects 1ms.
+            time.sleep(0.001)
+            return x
+
+    srv = RpcServer(_Echo())
+    cli = RpcClient(srv.address)
+    n_rpc = 200
+
+    def rpc_serial():
+        for _ in range(n_rpc):
+            cli.call("echo", x=1)
+
+    per, _ = timed(rpc_serial, min_time=1.0 * scale)
+    results["rpc_roundtrip_per_sec"] = round(n_rpc / per, 1)
+
+    def rpc_serial_1ms():
+        for _ in range(n_rpc):
+            cli.call("echo_1ms", x=1)
+
+    per, _ = timed(rpc_serial_1ms, min_time=1.0 * scale)
+    results["rpc_roundtrip_1ms_per_sec"] = round(n_rpc / per, 1)
+
+    def rpc_pipelined_1ms():
+        for f in [cli.call_async("echo_1ms", x=1) for _ in range(n_rpc)]:
+            f.result()
+
+    per, _ = timed(rpc_pipelined_1ms, min_time=1.0 * scale)
+    results["rpc_pipelined_1ms_per_sec"] = round(n_rpc / per, 1)
+    cli.close()
+    srv.stop()
+
     # 1GB store: a realistic fraction of a TPU-host's RAM — the default
     # 256MB can hold only two 100MB bandwidth-test objects, so the loop
     # would measure spill I/O instead of the put path. 4 workers: enough
@@ -164,6 +209,22 @@ def main(argv=None) -> int:
         results["actor_creation_per_sec"] = round(n_act / per, 1)
         results["host_cpus"] = os.cpu_count()  # creation is CPU-bound:
         # fork + worker boot + RPCs parallelize across cores on real hosts
+
+        # -- 100-actor wave (SCALE_r03 collapse scenario) -------------
+        # One coalesced register_actors + one start_actors batch + shared
+        # resolver long-poll; steady-state (recycled workers), like the
+        # repeated-wave shape of real serving/training fan-outs.
+        settle()
+        WaveCounter = Counter.options(num_cpus=0.01)
+
+        def actor_wave_100():
+            actors = [WaveCounter.remote() for _ in range(100)]
+            ray_tpu.get([x.incr.remote() for x in actors])
+            for x in actors:
+                ray_tpu.kill(x)
+
+        per, _ = timed(actor_wave_100, min_time=2.0 * scale, min_iters=2)
+        results["actor_creation_wave_100_per_sec"] = round(100 / per, 1)
 
         # -- wait over many refs --------------------------------------
         settle()

@@ -6,20 +6,31 @@ never goes through it: large objects move via the shm store and node-to-node
 chunk streaming in node_daemon.py, and dense math moves over ICI via XLA
 collectives).
 
-Wire format: [4B little-endian length][pickle((method, kwargs))] request,
-[4B length][pickle((ok, payload))] response. One in-flight request per
-connection; clients pool connections per target address.
+Wire format: [4B little-endian length][pickle(frame)] both ways. Two frame
+shapes coexist on the request side:
+
+- classic: ``(method, kwargs)`` — one in-flight request per connection,
+  response ``(ok, payload)``. Clients pool one socket per concurrent caller.
+- pipelined: ``(seq, method, kwargs)`` — many requests in flight per socket;
+  the server dispatches each frame on a per-connection pool and replies
+  ``(seq, ok, payload)`` in completion order, the client matches by seq
+  (parity: gRPC HTTP/2 stream multiplexing, grpc_client.h).
+
+``__batch__`` is a virtual method multiplexing N calls into one frame
+(parity: the reference's batched GCS RPCs); it rides either frame shape.
 """
 
 from __future__ import annotations
 
+import itertools
 import pickle
 import socket
 import socketserver
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class RpcError(Exception):
@@ -86,12 +97,57 @@ def _recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, length)
 
 
+def _dispatch(service: Any, method: str, kwargs: dict) -> Tuple[bool, Any]:
+    """Resolve and run one method; exceptions become the payload."""
+    try:
+        _maybe_inject_delay(method)
+        if method == "__batch__":
+            return True, [_dispatch(service, m, kw)
+                          for m, kw in kwargs["calls"]]
+        fn = getattr(service, "rpc_" + method, None)
+        if fn is None:
+            return False, RpcError(f"no such method: {method}")
+        return True, fn(**kwargs)
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 - shipped to caller
+        return False, e
+
+
+def _safe_dumps(resp: tuple) -> bytes:
+    try:
+        return pickle.dumps(resp, protocol=5)
+    except Exception:
+        # Replace the unpicklable payload, keep the frame shape (a seq
+        # prefix must survive so pipelined callers still match it).
+        err = RpcError("unpicklable response")
+        fallback = resp[:-2] + (False, err)
+        return pickle.dumps(fallback, protocol=5)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def setup(self):
         self.server._conns.add(self.request)  # type: ignore[attr-defined]
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._send_lock = threading.Lock()
 
     def finish(self):
         self.server._conns.discard(self.request)  # type: ignore[attr-defined]
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+    def _respond(self, resp: tuple) -> None:
+        payload = _safe_dumps(resp)
+        with self._send_lock:
+            _send_frame(self.request, payload)
+
+    def _run_pipelined(self, service: Any, seq: int, method: str,
+                       kwargs: dict) -> None:
+        ok, payload = _dispatch(service, method, kwargs)
+        try:
+            self._respond((seq, ok, payload))
+        except OSError:
+            pass  # peer gone; the read loop notices and exits
 
     def handle(self):
         sock = self.request
@@ -103,28 +159,30 @@ class _Handler(socketserver.BaseRequestHandler):
             except (ConnectionLost, OSError):
                 return
             try:
-                method, kwargs = pickle.loads(req)
-                _maybe_inject_delay(method)
-                fn = getattr(service, "rpc_" + method, None)
-                if fn is None:
-                    resp = (False, RpcError(f"no such method: {method}"))
+                frame = pickle.loads(req)
+                if len(frame) == 3:
+                    seq, method, kwargs = frame
                 else:
-                    resp = (True, fn(**kwargs))
-            except SystemExit:
-                raise
-            except BaseException as e:  # noqa: BLE001 - shipped to caller
-                try:
-                    resp = (False, e)
-                except Exception:
-                    resp = (False, RpcError(repr(e)))
+                    seq, (method, kwargs) = None, frame
+            except Exception:
+                return
+            if seq is not None:
+                # Pipelined frame: dispatch off-thread so the read loop
+                # keeps draining — a long-poll must not head-of-line-block
+                # the requests queued behind it on this socket.
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=16, thread_name_prefix="rpc-pipe")
+                self._pool.submit(self._run_pipelined, service, seq,
+                                  method, kwargs)
+                continue
+            # Classic frame: dispatch inline (no thread handoff on the
+            # latency-critical single-call path).
+            resp = _dispatch(service, method, kwargs)
             try:
-                _send_frame(sock, pickle.dumps(resp, protocol=5))
-            except (OSError, pickle.PicklingError):
-                try:
-                    _send_frame(sock, pickle.dumps(
-                        (False, RpcError("unpicklable response")), protocol=5))
-                except OSError:
-                    return
+                self._respond(resp)
+            except OSError:
+                return
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -174,6 +232,77 @@ class RpcServer:
                 pass
 
 
+class _PipeChannel:
+    """One pipelined connection: sequence-numbered frames, a reader thread
+    matching responses to waiting futures. Many callers share one socket
+    (the classic pool opens one socket per concurrent caller instead)."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._seq = itertools.count()
+        self.dead: Optional[BaseException] = None
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="rpc-pipe-reader")
+        self._reader.start()
+
+    def request(self, method: str, kwargs: dict) -> Future:
+        fut: Future = Future()
+        seq = next(self._seq)
+        with self._lock:
+            if self.dead is not None:
+                fut.set_exception(ConnectionLost(str(self.dead)))
+                return fut
+            self._pending[seq] = fut
+        try:
+            frame = pickle.dumps((seq, method, kwargs), protocol=5)
+            with self._send_lock:
+                _send_frame(self._sock, frame)
+        except BaseException as e:  # noqa: BLE001
+            with self._lock:
+                self._pending.pop(seq, None)
+            self._fail_all(e)
+            if not fut.done():
+                fut.set_exception(ConnectionLost(repr(e)))
+        return fut
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                seq, ok, payload = pickle.loads(_recv_frame(self._sock))
+            except BaseException as e:  # noqa: BLE001 - socket died
+                self._fail_all(e)
+                return
+            with self._lock:
+                fut = self._pending.pop(seq, None)
+            if fut is None:
+                continue
+            if ok:
+                fut.set_result(payload)
+            else:
+                exc = payload if isinstance(payload, BaseException) \
+                    else RpcError(str(payload))
+                fut.set_exception(exc)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            if self.dead is None:
+                self.dead = exc
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(repr(exc)))
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._fail_all(ConnectionLost("channel closed"))
+
+
 class RpcClient:
     """Pooled client: one socket per concurrent caller to one address.
 
@@ -200,6 +329,8 @@ class RpcClient:
         self._free: list = []
         self._lock = threading.Lock()
         self._closed = False
+        self._pipe: Optional[_PipeChannel] = None
+        self._pipe_lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self._target, timeout=self._timeout)
@@ -284,6 +415,63 @@ class RpcClient:
                 str(payload))
         return payload
 
+    # -- pipelined path ------------------------------------------------
+    def _channel(self) -> _PipeChannel:
+        with self._pipe_lock:
+            if self._closed:
+                raise ConnectionLost("client closed")
+            if self._pipe is None or self._pipe.dead is not None:
+                self._pipe = _PipeChannel(self._connect())
+            return self._pipe
+
+    def call_async(self, method: str, **kwargs) -> Future:
+        """Pipelined single-attempt call: returns a Future without waiting
+        for the round-trip, so N calls overlap on one socket. No automatic
+        resend — a dead channel fails the future with ConnectionLost (use
+        ``call_pipelined`` for the retrying sync flavor)."""
+        return self._channel().request(method, kwargs)
+
+    def call_pipelined(self, method: str, _timeout: Optional[float] = None,
+                       **kwargs) -> Any:
+        """Sync call over the shared pipelined channel, with the same
+        reconnect/at-least-once contract as ``call``."""
+        deadline = (time.monotonic() + self._reconnect_s
+                    if self._reconnect_s > 0 else None)
+        fresh_retry_done = False
+        while True:
+            try:
+                return self._channel().request(method, kwargs).result(
+                    timeout=_timeout if _timeout is not None
+                    else self._timeout)
+            except ConnectionLost:
+                if not fresh_retry_done:
+                    fresh_retry_done = True  # stale cached channel: one
+                    continue                 # immediate fresh-socket retry
+                if deadline is None or time.monotonic() >= deadline or \
+                        self._closed:
+                    raise
+                time.sleep(0.1)
+
+    def call_batch(self, calls: List[Tuple[str, dict]],
+                   _timeout: Optional[float] = None,
+                   return_exceptions: bool = False) -> List[Any]:
+        """Multiplex N method calls into ONE request frame (one round-trip,
+        one lock-step on each side). Returns results in call order; a
+        failed sub-call raises unless ``return_exceptions``."""
+        outcomes = self.call("__batch__", _timeout=_timeout,
+                             calls=[(m, kw) for m, kw in calls])
+        results = []
+        for ok, payload in outcomes:
+            if ok:
+                results.append(payload)
+            elif return_exceptions:
+                results.append(payload if isinstance(payload, BaseException)
+                               else RpcError(str(payload)))
+            else:
+                raise payload if isinstance(payload, BaseException) \
+                    else RpcError(str(payload))
+        return results
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -293,6 +481,10 @@ class RpcClient:
                 s.close()
             except OSError:
                 pass
+        with self._pipe_lock:
+            pipe, self._pipe = self._pipe, None
+        if pipe is not None:
+            pipe.close()
 
 
 _client_pool: Dict[Tuple[str, Optional[float], float], RpcClient] = {}

@@ -94,6 +94,7 @@ class WorkerService:
         self._seq_lock = threading.Lock()
         self._seq_cv = threading.Condition(self._seq_lock)
         self._next_seq: Dict[bytes, int] = {}
+        self._active_calls = 0   # in-flight pushes; gates process recycling
         # Pins taken over from callers for not-yet-run enqueued actor work;
         # released on kill/exit so a dead actor doesn't leak its arguments.
         self._taken_pins: Dict[bytes, int] = {}
@@ -319,10 +320,31 @@ class WorkerService:
     def rpc_push_actor_task(self, task_id: bytes, caller_id: bytes,
                             seqno: int, method_name: str, args_blob: bytes,
                             num_returns: int,
-                            arg_pins: Optional[list] = None) -> dict:
-        """Ordered actor call (per-caller seqno; see class docstring)."""
+                            arg_pins: Optional[list] = None,
+                            actor_id: Optional[bytes] = None) -> dict:
+        """Ordered actor call (per-caller seqno; see class docstring).
+        ``actor_id`` guards against a stale address: a recycled worker may
+        host a DIFFERENT actor at the address a slow caller cached, and a
+        push for the dead tenant must fail, not hit the new instance."""
+        if actor_id is not None and actor_id != self.actor_id:
+            raise RuntimeError("actor no longer hosted on this worker "
+                               "(stale address after recycle)")
         if self.actor_instance is None:
             raise RuntimeError("no actor hosted on this worker")
+        with self._seq_lock:
+            self._active_calls += 1
+        try:
+            return self._push_actor_task(task_id, caller_id, seqno,
+                                         method_name, args_blob,
+                                         num_returns, arg_pins)
+        finally:
+            with self._seq_lock:
+                self._active_calls -= 1
+
+    def _push_actor_task(self, task_id: bytes, caller_id: bytes,
+                         seqno: int, method_name: str, args_blob: bytes,
+                         num_returns: int,
+                         arg_pins: Optional[list] = None) -> dict:
         name = f"{self.actor_class_name}.{method_name}"
         start = time.time()
         error = ""
@@ -435,14 +457,58 @@ class WorkerService:
                 t.unpin_all([k] * n)
             t.flush()
 
+    def _recyclable(self) -> bool:
+        """A process may be returned to the daemon's idle pool only when
+        nothing of the dead actor can leak into the next tenant: sync-only
+        (an event loop / thread pool may still be running user coroutines),
+        and no push in flight."""
+        from ray_tpu import config
+        if not config.get("actor_worker_recycle"):
+            return False
+        if self.actor_is_async or self.actor_pool is not None:
+            return False
+        with self._seq_lock:
+            return self._active_calls == 0
+
+    def _reset_actor_state(self) -> None:
+        with self._seq_lock:
+            self.actor_id = None
+            self.actor_instance = None
+            self.actor_class_name = ""
+            self.actor_is_async = False
+            self.actor_max_concurrency = 1
+            self._next_seq.clear()   # new tenant's callers restart at seqno 0
+            self._taken_pins.clear()
+            self._cancelled.clear()
+            self._seq_cv.notify_all()
+
     def rpc_kill_actor(self, actor_id: bytes) -> dict:
+        if actor_id != self.actor_id:
+            # Previous tenant (recycled away) or duplicate kill retry after
+            # the state was already reset: nothing to do, and killing the
+            # process now could take down an innocent new tenant.
+            return {"ok": True, "stale": True}
         self.events.flush()
         self._release_taken_pins()
-        try:
-            get_client(self.daemon_address).call("actor_exited",
-                                                 actor_id=actor_id)
-        except Exception:
-            pass
+        recycled = False
+        if self._recyclable():
+            # Reset BEFORE offering the process back: the daemon may hand
+            # this worker to a new create_actor the instant it pools it.
+            self._reset_actor_state()
+            try:
+                resp = get_client(self.daemon_address).call(
+                    "actor_exited", actor_id=actor_id, recycle=True)
+                recycled = bool(resp and resp.get("recycled"))
+            except Exception:
+                recycled = False
+        else:
+            try:
+                get_client(self.daemon_address).call("actor_exited",
+                                                     actor_id=actor_id)
+            except Exception:
+                pass
+        if recycled:
+            return {"ok": True, "recycled": True}
         self._shutdown.set()
         threading.Timer(0.1, lambda: os._exit(0)).start()
         return {"ok": True}

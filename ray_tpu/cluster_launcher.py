@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import os
+import select
 import signal
 import subprocess
 import sys
@@ -226,7 +227,17 @@ def up(config_path: str, wait_s: float = 120.0) -> str:
         env=env, start_new_session=True)   # survives this CLI exiting
     deadline = time.monotonic() + wait_s
     address = None
+    # Deadline-aware poll: a head session that wedges BEFORE printing
+    # HEAD_READY (TPU init hang, import deadlock) keeps the pipe open and
+    # a bare readline() would block this CLI forever.
     while time.monotonic() < deadline:
+        remaining = deadline - time.monotonic()
+        ready, _, _ = select.select([proc.stdout], [], [],
+                                    max(0.0, min(remaining, 0.5)))
+        if not ready:
+            if proc.poll() is not None:
+                break  # session died without HEAD_READY
+            continue
         line = proc.stdout.readline()
         if not line:
             break
@@ -235,7 +246,8 @@ def up(config_path: str, wait_s: float = 120.0) -> str:
             break
     if address is None:
         proc.terminate()
-        raise RuntimeError("head session failed to come up")
+        raise RuntimeError(
+            f"head session failed to come up within {wait_s}s")
     proc.stdout.close()   # detach; the session runs on
     _SESSIONS[cfg["cluster_name"]] = proc
     print(f"cluster {cfg['cluster_name']!r} up at {address}")

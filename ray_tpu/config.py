@@ -140,6 +140,24 @@ define("scheduler_spread_threshold", float, 0.5,
        "Hybrid policy: prefer local node until its critical-resource "
        "utilization exceeds this fraction, then best-score remote.")
 define("max_pending_lease_requests", int, 10, "In-flight lease requests per key.")
+define("actor_start_pool_size", int, 8,
+       "Bounded pool of concurrent actor bring-ups per node daemon: a wave "
+       "spawns this many workers at once instead of one thread per actor "
+       "(unbounded concurrent boots thrash small hosts).")
+define("actor_worker_recycle", bool, True,
+       "Return the worker of a cleanly killed sync actor to the idle pool "
+       "instead of killing the process; the next actor creation then skips "
+       "fork+boot entirely (the dominant cost of an actor wave).")
+define("actor_recycle_pool_cap", int, 128,
+       "Idle-pool cap applied when recycling actor workers (the task "
+       "pool's worker_pool_max_size stays the spawn-side cap).")
+define("control_plane_batching", bool, True,
+       "Batch control-plane RPCs (register_actors waves, shared actor "
+       "resolution, multi-lease grants). Off = serialized per-actor "
+       "round-trips; kept as the regression baseline for benchmarks.")
+define("lease_multi_grant", int, 4,
+       "Max leases granted per request_leases round-trip when a deep task "
+       "queue needs pool growth (1 = single-grant behavior).")
 
 # Health / fault tolerance
 define("health_check_period_s", float, 1.0, "Conductor -> node liveness ping period.")
@@ -178,6 +196,9 @@ define("rpc_message_max_bytes", int, 512 * 1024 * 1024, "Max framed message size
 define("tpu_force_host_platform", bool, False,
        "Treat CPU devices as the TPU plane (for tests on a virtual mesh).")
 define("tpu_chips_per_host_override", int, 0, "0 = autodetect from jax.")
+define("tpu_probe_timeout_s", float, 20.0,
+       "Hard deadline for the subprocess device-count probe; a wedged PJRT "
+       "backend degrades to 0 chips instead of hanging init().")
 
 # Observability
 define("task_event_buffer_size", int, 65536, "Task lifecycle events retained.")

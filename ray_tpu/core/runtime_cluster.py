@@ -321,18 +321,26 @@ class TaskSubmitter:
 
     def _acquire_lease(self, st: _KeyState, task: dict) -> None:
         from ray_tpu.core.exceptions import RuntimeEnvSetupError
+        # Deep queue -> ask for several grants in ONE round-trip (extras
+        # only come from already-warm workers, so over-asking is cheap).
+        want = 1
+        if config.get("control_plane_batching"):
+            with st.lock:
+                want = max(1, min(int(config.get("lease_multi_grant")),
+                                  len(st.queue)))
         try:
             try:
-                w = self.rt._lease_worker(task["resources"],
-                                          task["strategy"],
-                                          task.get("runtime_env"))
+                ws = self.rt._lease_worker(task["resources"],
+                                           task["strategy"],
+                                           task.get("runtime_env"),
+                                           count=want)
             except RuntimeEnvSetupError as e:
                 self._fail_queued(st, e)
                 return
         finally:
             with st.lock:
                 st.pending_leases -= 1
-        if w is None:
+        if not ws:
             # Couldn't lease anywhere right now; retry while work remains.
             with st.lock:
                 still_needed = bool(st.queue)
@@ -343,8 +351,9 @@ class TaskSubmitter:
                 self._lease_pool.submit(self._acquire_lease, st, task)
             return
         with st.lock:
-            w.idle_since = time.monotonic()
-            st.idle.append(w)
+            for w in ws:
+                w.idle_since = time.monotonic()
+                st.idle.append(w)
         self._pump(st)
         # If the queue drained while this lease was in flight, the reaper
         # returns the unused grant after the linger window.
@@ -503,6 +512,72 @@ class TaskSubmitter:
         return True
 
 
+class _ActorResolver:
+    """Shared batched actor-address resolution: ONE conductor
+    ``get_actor_infos`` long-poll serves every _ActorClient of this process
+    that is waiting for an address. A 100-actor wave would otherwise hold
+    100 sockets in per-actor long-polls and pay 100 serialized round-trips
+    (the r05 wave collapse). Falls back to per-actor ``get_actor_info``
+    when control_plane_batching is off."""
+
+    def __init__(self, rt: "ClusterRuntime"):
+        self.rt = rt
+        self._cv = threading.Condition()
+        self._reqs: List[dict] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+
+    def resolve(self, actor_id: bytes, timeout: float) -> dict:
+        if not config.get("control_plane_batching"):
+            return self.rt.conductor.call("get_actor_info",
+                                          actor_id=actor_id,
+                                          wait_alive_timeout=timeout)
+        req = {"actor_id": actor_id, "info": None, "ev": threading.Event()}
+        with self._cv:
+            self._reqs.append(req)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="actor-resolve")
+                self._thread.start()
+            self._cv.notify_all()
+        req["ev"].wait(timeout)
+        with self._cv:
+            try:
+                self._reqs.remove(req)
+            except ValueError:
+                pass
+        return req["info"] or {"state": "PENDING"}
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._reqs and not self._stop:
+                    self._cv.wait(0.5)
+                if self._stop:
+                    return
+                ids = list(dict.fromkeys(r["actor_id"] for r in self._reqs))
+            try:
+                infos = self.rt.conductor.call(
+                    "get_actor_infos", actor_ids=ids,
+                    wait_alive_timeout=2.0, _timeout=30.0)
+            except Exception:
+                time.sleep(0.2)
+                continue
+            by_id = dict(zip(ids, infos))
+            with self._cv:
+                for r in self._reqs:
+                    info = by_id.get(r["actor_id"])
+                    if info is not None and info.get("state") in (
+                            "ALIVE", "DEAD"):
+                        r["info"] = info
+                        r["ev"].set()
+
+
 class _ActorClient:
     """Ordered pusher for one actor (direct_actor_task_submitter.h:67)."""
 
@@ -562,9 +637,16 @@ class _ActorClient:
                 self.rt._unpin_task(task)
 
     def _resolve_address(self, timeout: float = 300.0) -> bool:
-        info = self.rt.conductor.call("get_actor_info",
-                                      actor_id=self.actor_id,
-                                      wait_alive_timeout=timeout)
+        err = self.rt._reg_failed.pop(self.actor_id, None)
+        if err is not None:
+            # The coalesced registration RPC for this actor never reached
+            # the conductor; the actor will never exist.
+            self.death_error = TaskError.from_exception(err, self.class_name)
+            with self.cv:
+                self.dead = True
+                self.cv.notify_all()
+            return False
+        info = self.rt._actor_resolver.resolve(self.actor_id, timeout)
         if info["state"] == "ALIVE":
             if info["incarnation"] != self.incarnation:
                 self.incarnation = info["incarnation"]
@@ -609,7 +691,8 @@ class _ActorClient:
                     method_name=task["method_name"],
                     args_blob=task["args_blob"],
                     num_returns=task["num_returns"],
-                    arg_pins=task.get("pin_keys") or [])
+                    arg_pins=task.get("pin_keys") or [],
+                    actor_id=self.actor_id)
                 self.seqno = seq + 1
                 return
             except Exception:
@@ -745,6 +828,15 @@ class ClusterRuntime:
         self.submitter = TaskSubmitter(self)
         self._actor_clients: Dict[bytes, _ActorClient] = {}
         self._actor_meta: Dict[bytes, dict] = {}
+        self._actor_resolver = _ActorResolver(self)
+        # Registration coalescer: unnamed-actor registrations queue here and
+        # ship as ONE register_actors RPC per flush (lazy thread).
+        self._reg_cv = threading.Condition()
+        self._reg_pending: List[dict] = []
+        self._reg_busy = False
+        self._reg_stop = False
+        self._reg_thread: Optional[threading.Thread] = None
+        self._reg_failed: Dict[bytes, BaseException] = {}
         self._oid_actor: Dict[bytes, bytes] = {}
         self._lock = threading.Lock()
         self.address = self.conductor_address
@@ -794,15 +886,19 @@ class ClusterRuntime:
         return None
 
     def _lease_worker(self, resources: Dict[str, float], strategy: Any,
-                      runtime_env: Optional[dict]) -> Optional[_LeasedWorker]:
+                      runtime_env: Optional[dict],
+                      count: int = 1) -> List[_LeasedWorker]:
         """Locality-preferring lease acquisition with spillback (parity:
-        lease_policy.cc + spillback replies of HandleRequestWorkerLease)."""
+        lease_policy.cc + spillback replies of HandleRequestWorkerLease).
+        Returns up to ``count`` grants from the FIRST daemon that grants at
+        all (multi-grant extras never spill: they only exist to drain a
+        deep local queue); empty list when nothing granted anywhere."""
         targets: List[str] = []
         if isinstance(strategy, dict) and strategy.get("type") == "pg":
             pg = self.conductor.call("pg_ready", pg_id=strategy["pg_id"],
                                      timeout=30.0)
             if pg["state"] != "CREATED":
-                return None
+                return []
             idx = strategy.get("bundle_index", 0)
             nodes = pg["bundle_nodes"]
             candidates = ([nodes[idx]] if idx >= 0
@@ -816,7 +912,7 @@ class ClusterRuntime:
             if addr:
                 targets.append(addr)
             if not addr and not strategy.get("soft"):
-                return None
+                return []
         elif isinstance(strategy, dict) and strategy.get("type") == "slice":
             # Candidates are hosts of complete slices of the requested
             # topology — never arbitrary nodes (a slice task must be able
@@ -834,7 +930,7 @@ class ClusterRuntime:
                 if n["alive"] and n["node_id"] in wanted:
                     targets.append(n["address"])
             if not targets:
-                return None
+                return []
         if not targets:
             targets = [self.daemon_address]
             nodes = sorted(
@@ -850,21 +946,28 @@ class ClusterRuntime:
                 # thread forever — wait_timeout covers the resource wait and
                 # the daemon's 10s worker-checkout budget rides on top.
                 wait = 1.0 if addr == targets[-1] else 0.3
-                resp = get_client(addr).call(
-                    "request_lease", resources=resources,
-                    runtime_env=runtime_env, strategy=strategy,
-                    wait_timeout=wait, _timeout=wait + 15.0)
+                if count > 1:
+                    resp = get_client(addr).call(
+                        "request_leases", resources=resources, count=count,
+                        runtime_env=runtime_env, strategy=strategy,
+                        wait_timeout=wait, _timeout=wait + 15.0)
+                else:
+                    resp = get_client(addr).call(
+                        "request_lease", resources=resources,
+                        runtime_env=runtime_env, strategy=strategy,
+                        wait_timeout=wait, _timeout=wait + 15.0)
             except Exception:
                 continue
             if resp.get("granted"):
-                return _LeasedWorker(resp["lease_id"],
-                                     resp["worker_address"], addr)
+                grants = resp.get("leases") or [resp]
+                return [_LeasedWorker(g["lease_id"], g["worker_address"],
+                                      addr) for g in grants]
             if resp.get("env_error"):
                 # Deterministic env-materialization failure: retrying on
                 # another node re-runs the same broken spec. Fail fast.
                 from ray_tpu.core.exceptions import RuntimeEnvSetupError
                 raise RuntimeEnvSetupError(resp["env_error"])
-        return None
+        return []
 
     def _release_lease(self, w: _LeasedWorker) -> None:
         try:
@@ -1182,10 +1285,17 @@ class ClusterRuntime:
                 "runtime_env": opts.runtime_env,
             },
         }
-        resp = self.conductor.call("register_actor",
-                                   actor_id=actor_id.binary(), spec=spec)
-        if resp.get("existing") is not None:
-            return self._handle_for(resp["existing"])
+        if (not opts.name and not opts.get_if_exists
+                and config.get("control_plane_batching")):
+            # Unnamed actor: the id is client-generated and collisions are
+            # impossible, so registration needs no reply — coalesce it.
+            # A 100-actor wave then costs O(few) conductor round-trips.
+            self._enqueue_registration(actor_id.binary(), spec)
+        else:
+            resp = self.conductor.call("register_actor",
+                                       actor_id=actor_id.binary(), spec=spec)
+            if resp.get("existing") is not None:
+                return self._handle_for(resp["existing"])
         with self._lock:
             self._actor_meta[actor_id.binary()] = {
                 "methods": methods, "is_async": is_async,
@@ -1193,6 +1303,49 @@ class ClusterRuntime:
                 "max_task_retries": opts.max_task_retries,
             }
         return ActorHandle(actor_id, desc.repr_name(), methods, is_async)
+
+    def _enqueue_registration(self, actor_id: bytes, spec: dict) -> None:
+        with self._reg_cv:
+            self._reg_pending.append({"actor_id": actor_id, "spec": spec})
+            if self._reg_thread is None or not self._reg_thread.is_alive():
+                self._reg_thread = threading.Thread(
+                    target=self._reg_loop, daemon=True, name="actor-reg")
+                self._reg_thread.start()
+            self._reg_cv.notify_all()
+
+    def _reg_loop(self) -> None:
+        while True:
+            with self._reg_cv:
+                while not self._reg_pending and not self._reg_stop:
+                    self._reg_cv.wait(0.5)
+                if not self._reg_pending:
+                    return  # stopping and drained
+                batch, self._reg_pending = self._reg_pending, []
+                self._reg_busy = True
+            try:
+                self.conductor.call("register_actors", items=batch)
+            except BaseException as e:  # noqa: BLE001
+                with self._reg_cv:
+                    for item in batch:
+                        self._reg_failed[item["actor_id"]] = e
+            finally:
+                with self._reg_cv:
+                    self._reg_busy = False
+                    self._reg_cv.notify_all()
+
+    def _flush_registrations(self, timeout: float = 30.0) -> None:
+        """Wait until every queued registration reached the conductor.
+        Must run before any conductor call that LOOKS UP one of these
+        actors and treats 'unknown id' as a silent no-op (kill_actor:
+        killing a not-yet-registered actor would otherwise leak it as a
+        forever-running orphan)."""
+        deadline = time.monotonic() + timeout
+        with self._reg_cv:
+            while self._reg_pending or self._reg_busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._reg_cv.wait(min(remaining, 0.5))
 
     def _handle_for(self, actor_id: bytes) -> ActorHandle:
         meta = self._actor_meta.get(actor_id)
@@ -1245,6 +1398,7 @@ class ClusterRuntime:
         return refs
 
     def kill_actor(self, handle: ActorHandle, no_restart: bool = True) -> None:
+        self._flush_registrations()
         self.conductor.call("kill_actor",
                             actor_id=handle._rt_actor_id.binary(),
                             no_restart=no_restart)
@@ -1332,6 +1486,14 @@ class ClusterRuntime:
         from ray_tpu.core import refs as _refs_mod
         try:
             self._log_stop.set()
+        except AttributeError:
+            pass
+        try:
+            self._flush_registrations(timeout=5.0)
+            with self._reg_cv:
+                self._reg_stop = True
+                self._reg_cv.notify_all()
+            self._actor_resolver.stop()
         except AttributeError:
             pass
         if _refs_mod._tracker is self._ref_tracker:

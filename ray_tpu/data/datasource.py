@@ -114,7 +114,10 @@ def _read_image_file(path: str, size=None, mode: Optional[str] = None):
         elif im.mode not in ("RGB", "L"):
             im = im.convert("RGB")
         if size is not None:
-            im = im.resize(tuple(size))
+            # ``size`` follows the reference's (height, width) convention;
+            # PIL's resize takes (width, height).
+            h, w = size
+            im = im.resize((w, h))
         arr = np.asarray(im)
     if arr.ndim == 2:
         arr = arr[:, :, None]

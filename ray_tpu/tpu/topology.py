@@ -172,11 +172,44 @@ def platform_pinned_off_tpu() -> bool:
     return bool(plats) and "tpu" not in plats and "axon" not in plats
 
 
+# Source for the sacrificial device-count probe. Module-level so tests can
+# substitute a wedged backend (e.g. a sleep) without a real TPU.
+_PROBE_SRC = """
+import jax, sys
+sys.stdout.write(str(jax.local_device_count()))
+"""
+
+_chip_count_cache: Optional[int] = None
+
+
+def _probe_chip_count(timeout_s: float) -> int:
+    """Count local devices in a THROWAWAY subprocess under a hard deadline.
+    The first touch of a wedged PJRT backend blocks forever inside the
+    plugin (uninterruptible C++), so no in-process guard can recover; the
+    probe is sacrificial — on timeout or any failure it is killed and we
+    degrade to 0 chips instead of hanging ray_tpu.init()."""
+    import subprocess
+    import sys
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, timeout=timeout_s)
+        if out.returncode == 0:
+            return int(out.stdout.strip() or 0)
+    except Exception:  # noqa: BLE001 - timeout, spawn failure, bad output
+        pass
+    return 0
+
+
 def local_chip_count() -> int:
+    global _chip_count_cache
     if platform_pinned_off_tpu():
         return 0
-    import jax
-    return jax.local_device_count()
+    if _chip_count_cache is None:
+        from ray_tpu import config
+        _chip_count_cache = _probe_chip_count(
+            config.get("tpu_probe_timeout_s"))
+    return _chip_count_cache
 
 
 def detect_topology() -> TpuTopology:

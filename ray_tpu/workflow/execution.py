@@ -113,8 +113,12 @@ def _execute_durable(node: DAGNode, workflow_id: str,
             # Dynamic workflow: the sub-DAG replaces this step. Its own
             # steps checkpoint under a namespaced prefix, so resume
             # re-enters the continuation and skips its finished parts.
-            sub_ids = _assign_step_ids(out.dag, prefix=f"{step_id}.c/")
-            out = _execute_durable(out.dag, workflow_id, sub_ids, {},
+            # Placeholders (workflow.event() inside the continuation) get
+            # THIS workflow's id — without it the event step polls a key
+            # under the placeholder repr and hangs forever.
+            sub_dag = _inject_workflow_id(out.dag, workflow_id)
+            sub_ids = _assign_step_ids(sub_dag, prefix=f"{step_id}.c/")
+            out = _execute_durable(sub_dag, workflow_id, sub_ids, {},
                                    out.input_value)
     _checkpoint(workflow_id, step_id, out)
     memo[key] = out
@@ -171,23 +175,35 @@ class _WorkflowIdPlaceholder:
     """Replaced with the actual workflow id when run() walks the DAG."""
 
 
-def _inject_workflow_id(dag: DAGNode, workflow_id: str) -> None:
-    seen = set()
+def _inject_workflow_id(dag: DAGNode, workflow_id: str) -> DAGNode:
+    """Return a COPY of ``dag`` with every _WorkflowIdPlaceholder replaced.
+    Non-destructive: the caller's DAG keeps its placeholders, so the same
+    DAG object can be run again under a different workflow_id, and a
+    continuation's sub-DAG (built once inside user code) can be injected
+    at every incarnation. Shared nodes stay shared in the copy (memo), so
+    step identity by ``id(node)`` still dedupes diamonds."""
+    import copy
+    memo: Dict[int, DAGNode] = {}
 
-    def walk(node: DAGNode):
-        if id(node) in seen:
-            return
-        seen.add(id(node))
-        node._bound_args = tuple(
-            workflow_id if isinstance(a, _WorkflowIdPlaceholder) else a
-            for a in node._bound_args)
-        node._bound_kwargs = {
-            k: workflow_id if isinstance(v, _WorkflowIdPlaceholder) else v
-            for k, v in node._bound_kwargs.items()}
-        for child in node._children():
-            walk(child)
+    def sub(v):
+        if isinstance(v, _WorkflowIdPlaceholder):
+            return workflow_id
+        if isinstance(v, DAGNode):
+            return walk(v)
+        return v
 
-    walk(dag)
+    def walk(node: DAGNode) -> DAGNode:
+        got = memo.get(id(node))
+        if got is not None:
+            return got
+        clone = copy.copy(node)
+        memo[id(node)] = clone
+        clone._bound_args = tuple(sub(a) for a in node._bound_args)
+        clone._bound_kwargs = {k: sub(v)
+                               for k, v in node._bound_kwargs.items()}
+        return clone
+
+    return walk(dag)
 
 
 # ---------------------------------------------------------------------------
@@ -211,9 +227,12 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
 
     import cloudpickle
     workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:8]}"
-    _inject_workflow_id(dag, workflow_id)
+    # Persist the PRE-injection DAG (placeholders intact): resume() re-runs
+    # it under its stored id, and the user's object stays reusable under a
+    # different workflow_id.
     _set_status(workflow_id, "RUNNING", cloudpickle.dumps(dag),
                 cloudpickle.dumps(input_value))
+    dag = _inject_workflow_id(dag, workflow_id)
     step_ids = _assign_step_ids(dag)
     try:
         out = _execute_durable(dag, workflow_id, step_ids, {}, input_value)

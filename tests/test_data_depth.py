@@ -81,6 +81,21 @@ def test_read_images(rt, tmp_path):
     assert heights == [10, 11, 12, 13, 14, 15]
 
 
+def test_read_images_non_square_size(rt, tmp_path):
+    # size follows the (height, width) convention; PIL's resize takes
+    # (width, height) — a square-only test can't catch a swap.
+    from PIL import Image
+    arr = np.zeros((10, 20, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(tmp_path / "wide.png")
+
+    ds = rdata.read_images(str(tmp_path), size=(16, 6))
+    rows = ds.take_all()
+    assert len(rows) == 1
+    assert rows[0]["height"] == 16 and rows[0]["width"] == 6
+    batch = next(iter(ds.iter_batches(batch_size=1)))
+    assert batch["image"].shape == (1, 16, 6, 3)
+
+
 # -- tfrecords ------------------------------------------------------------
 
 def test_tfrecord_codec_roundtrip(tmp_path):

@@ -128,3 +128,53 @@ def test_build_mesh_topology_aware_off_keeps_order():
 def test_mesh_spec_validation_still_raises():
     with pytest.raises(ValueError):
         build_mesh(MeshSpec(dp=128), devices=_fake_torus((2, 2, 2)))
+
+
+# -- chip-count probe (topology.py) ---------------------------------------
+
+
+def test_chip_probe_counts_devices(monkeypatch):
+    from ray_tpu.tpu import topology
+
+    monkeypatch.setattr(topology, "platform_pinned_off_tpu", lambda: False)
+    monkeypatch.setattr(topology, "_chip_count_cache", None)
+    monkeypatch.setattr(topology, "_PROBE_SRC",
+                        "import sys; sys.stdout.write('4')")
+    assert topology.local_chip_count() == 4
+    # cached: a changed probe source is NOT re-run
+    monkeypatch.setattr(topology, "_PROBE_SRC",
+                        "import sys; sys.stdout.write('8')")
+    assert topology.local_chip_count() == 4
+
+
+def test_chip_probe_wedged_backend_degrades_within_deadline(monkeypatch):
+    # A wedged PJRT plugin blocks the first backend touch forever; the
+    # probe is a sacrificial subprocess, so init degrades to 0 chips
+    # after tpu_probe_timeout_s instead of hanging.
+    import time
+
+    from ray_tpu import config
+    from ray_tpu.tpu import topology
+
+    monkeypatch.setattr(topology, "platform_pinned_off_tpu", lambda: False)
+    monkeypatch.setattr(topology, "_chip_count_cache", None)
+    monkeypatch.setattr(topology, "_PROBE_SRC", "import time; time.sleep(60)")
+    config.set_override("tpu_probe_timeout_s", 0.5)
+    try:
+        t0 = time.monotonic()
+        assert topology.local_chip_count() == 0
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        config.clear_override("tpu_probe_timeout_s")
+
+
+def test_chip_probe_skipped_when_pinned_off_tpu(monkeypatch):
+    # JAX_PLATFORMS=cpu processes must never touch the TPU backend, not
+    # even through the sacrificial subprocess.
+    from ray_tpu.tpu import topology
+
+    monkeypatch.setattr(topology, "_chip_count_cache", None)
+    monkeypatch.setattr(
+        topology, "_probe_chip_count",
+        lambda *_: (_ for _ in ()).throw(AssertionError("probed!")))
+    assert topology.local_chip_count() == 0
