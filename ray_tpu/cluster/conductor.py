@@ -22,6 +22,7 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu.cluster import fault_plane
 from ray_tpu.cluster.protocol import RpcServer, get_client
 
 # Actor FSM states (parity: gcs_actor_manager.h:249 state diagram).
@@ -87,6 +88,11 @@ class Conductor:
         self._named_actors: Dict[Tuple[str, str], bytes] = {}
         self._object_locations: Dict[bytes, Set[bytes]] = defaultdict(set)
         self._object_spilled: Dict[bytes, str] = {}  # oid -> spill path/url
+        # Objects whose every registered copy died with its node (and no
+        # spill). Lets locate_object tell getters "lost, stop waiting"
+        # instead of being indistinguishable from not-yet-computed; cleared
+        # when a copy re-registers (lineage reconstruction).
+        self._lost_objects: Set[bytes] = set()
         # --- distributed refcounting (reference_count.h:61, centralized;
         #     counts driven by ordered event streams from every process) ---
         self._refcounts: Dict[bytes, int] = {}
@@ -134,11 +140,17 @@ class Conductor:
         journal has its own lock and does no RPC)."""
         if self._journal is None:
             return
+        # Fault points bracketing the durable write: a crash on "pre"
+        # loses the mutation (clients re-drive via at-least-once RPC); a
+        # crash on "post" leaves a committed-but-unacked record the
+        # journal's CRC framing and dedup-by-id replay must absorb.
+        fault_plane.fire("conductor.journal.append", kind=kind, stage="pre")
         try:
             if self._journal.append(kind, data):
                 self._compact_due = True
         except OSError:
             pass
+        fault_plane.fire("conductor.journal.append", kind=kind, stage="post")
 
     def _emit_event(self, severity: str, source: str, event_type: str,
                     message: str, **metadata) -> None:
@@ -486,6 +498,7 @@ class Conductor:
                 locs.discard(node_id)
                 if not locs and oid not in self._object_spilled:
                     del self._object_locations[oid]
+                    self._lost_objects.add(oid)
             # Actors on this node die (and maybe restart).
             for a in self._actors.values():
                 if a.node_id == node_id and a.state in (ALIVE, PENDING_CREATION,
@@ -576,6 +589,7 @@ class Conductor:
     # Object directory (centralizes ownership_based_object_directory.h)
     # ------------------------------------------------------------------
     def rpc_add_object_location(self, oid: bytes, node_id: bytes) -> None:
+        fault_plane.fire("conductor.location.add", n=1)
         with self._cv:
             if oid in self._ref_tombstones:
                 # Sealed after its refcount hit zero (fire-and-forget task
@@ -586,6 +600,7 @@ class Conductor:
                     self._enqueue_delete(info["address"], oid)
                 return
             self._object_locations[oid].add(node_id)
+            self._lost_objects.discard(oid)
             self._cv.notify_all()
 
     def rpc_add_object_locations(self, oids: List[bytes],
@@ -595,6 +610,7 @@ class Conductor:
         per-result registrations (object_plane._LocationBatcher). Same
         tombstone semantics as the single-oid path: a copy sealed after
         its refcount hit zero is a leak — delete it at the source."""
+        fault_plane.fire("conductor.location.add", n=len(oids))
         with self._cv:
             info = self._nodes.get(node_id)
             addr = info["address"] if info and info["alive"] else None
@@ -604,13 +620,22 @@ class Conductor:
                         self._enqueue_delete(addr, oid)
                     continue
                 self._object_locations[oid].add(node_id)
+                self._lost_objects.discard(oid)
             self._cv.notify_all()
 
     def rpc_remove_object_location(self, oid: bytes, node_id: bytes) -> None:
-        with self._lock:
+        """A puller found the directory stale: the holder denied having the
+        object or was unreachable. Dropping the entry keeps other getters
+        from hammering the same dead copy; if it was the last one (and no
+        spill), the object is lost and waiters are told so."""
+        with self._cv:
             locs = self._object_locations.get(oid)
             if locs:
                 locs.discard(node_id)
+                if not locs and oid not in self._object_spilled:
+                    del self._object_locations[oid]
+                    self._lost_objects.add(oid)
+                    self._cv.notify_all()
 
     def rpc_add_spilled(self, oid: bytes, url: str) -> None:
         with self._cv:
@@ -627,15 +652,17 @@ class Conductor:
                 locs = [self._nodes[n] for n in self._object_locations.get(oid, ())
                         if n in self._nodes and self._nodes[n]["alive"]]
                 spilled = self._object_spilled.get(oid)
-                if locs or spilled or timeout <= 0:
+                lost = not locs and not spilled and oid in self._lost_objects
+                if locs or spilled or lost or timeout <= 0:
                     return {
                         "nodes": [{"node_id": n["node_id"],
                                    "address": n["address"]} for n in locs],
                         "spilled": spilled,
+                        "lost": lost,
                     }
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return {"nodes": [], "spilled": None}
+                    return {"nodes": [], "spilled": None, "lost": False}
                 self._cv.wait(min(remaining, 1.0))
 
     def rpc_objects_exist(self, oids: List[bytes]) -> List[bool]:
@@ -746,6 +773,7 @@ class Conductor:
                 if info is not None and info["alive"]:
                     self._enqueue_delete(info["address"], k)
             self._object_spilled.pop(k, None)
+            self._lost_objects.discard(k)
             for child in self._ref_children.pop(k, ()):
                 c = self._refcounts.get(child, 0) - 1
                 if c <= 0:
@@ -762,6 +790,10 @@ class Conductor:
         with self._lock:
             for k in keys:
                 self._ref_tombstones.discard(k)
+                # Reconstruction is in flight: stop telling getters the
+                # object is unrecoverably lost (they'd give up while the
+                # re-executed task is still producing the new copy).
+                self._lost_objects.discard(k)
 
     def _enqueue_delete(self, addr: str, oid: bytes) -> None:
         with self._free_cv:
@@ -794,6 +826,7 @@ class Conductor:
                      for n in self._object_locations.pop(oid, ())
                      if n in self._nodes and self._nodes[n]["alive"]]
             self._object_spilled.pop(oid, None)
+            self._lost_objects.discard(oid)
         for addr in nodes:
             try:
                 get_client(addr).call("delete_object", oid=oid)
@@ -925,6 +958,10 @@ class Conductor:
         conductor sends ONE ``start_actors`` RPC per target daemon instead
         of one ``start_actor`` per actor (the round-5 profile pinned wave
         collapse on exactly these serialized per-actor round-trips)."""
+        # Fault point: delay/raise while a wave is being placed (a raise
+        # here fails the scheduling pass; pending actors re-enter via the
+        # retry timers / restart FSM, which is what chaos runs verify).
+        fault_plane.fire("conductor.actor.schedule", count=len(actor_ids))
         by_node: Dict[str, List[dict]] = {}
         node_of: Dict[str, bytes] = {}
         for actor_id in actor_ids:

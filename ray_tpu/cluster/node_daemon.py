@@ -26,7 +26,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import config
-from ray_tpu.cluster import object_client
+from ray_tpu.cluster import fault_plane, object_client
 from ray_tpu.cluster.protocol import RpcServer, get_client
 
 CHUNK_SIZE = 8 << 20  # object transfer chunk (reference uses 5MiB chunks)
@@ -375,6 +375,10 @@ class NodeDaemon:
         detection latency, worker_pool.h:156's prestart rationale)."""
         env = dict(os.environ)
         env.update(self._env_vars)
+        # Ship live system-config overrides (worker_main.load_from_env
+        # applies them): a chaos plan or flag flip set before the spawn
+        # reaches every child worker, not just in-process planes.
+        env.update(config.propagation_env())
         env.setdefault("JAX_PLATFORMS",
                        env.get("RTPU_WORKER_JAX_PLATFORMS", "cpu"))
         if env.get("JAX_PLATFORMS") == "cpu":
@@ -450,6 +454,7 @@ class NodeDaemon:
             # session dir; spawning into it would die on the log-file open
             # with an unhandled FileNotFoundError in the start thread.
             raise _DaemonStopping("node daemon is stopping")
+        fault_plane.fire("daemon.worker.spawn", env_key=env_key)
         token = uuid.uuid4().hex
         if env_key == "" and not runtime_env:
             # Default-env workers fork from the zygote when possible.
@@ -461,7 +466,11 @@ class NodeDaemon:
                     "--token", token]
             log_path = os.path.join(self.session_dir,
                                     f"worker-{token[:8]}.out")
-            proc = self._fork_worker(argv, {}, log_path)
+            # Delta env over the zygote's baseline: overrides set AFTER the
+            # zygote started (a freshly loaded fault plan) still reach the
+            # forked child.
+            proc = self._fork_worker(argv, config.propagation_env(),
+                                     log_path)
             if proc is not None:
                 w = _Worker(proc, token, env_key)
                 with self._lock:
@@ -720,6 +729,13 @@ class NodeDaemon:
                         "reason": f"worker process died (exit {exit_code})",
                         "incarnation": w.actor_incarnation,
                     }
+                    # Free the crashed actor's reservation BEFORE reporting
+                    # the death: the conductor reacts by rescheduling the
+                    # restart incarnation, which on a full node can only
+                    # place if the dead incarnation's resources are back in
+                    # the pool (a leak here starved every restart for the
+                    # whole 30s placement window, then failed the actor).
+                    self._release_actor_resources(w)
                     try:
                         get_client(self.conductor_address).call(
                             "report_actor_death", **report)
@@ -766,6 +782,7 @@ class NodeDaemon:
                           idle_only: bool = False) -> dict:
         """Grant a worker lease, queue until resources free (bounded wait),
         or reply infeasible so the caller spills to another node."""
+        fault_plane.fire("daemon.lease.grant", idle_only=idle_only)
         resources = {k: v for k, v in resources.items() if v > 0}
         avail_fn, take, _ = self._resource_pool_for(strategy)
         deadline = time.monotonic() + wait_timeout
@@ -1145,6 +1162,7 @@ class NodeDaemon:
         return {"found": True, "size": size}
 
     def rpc_fetch_chunk(self, oid: bytes, offset: int, size: int) -> bytes:
+        fault_plane.fire("daemon.chunk.serve", oid=oid, offset=offset)
         view = self.store.get(oid, timeout=0.0)
         if view is None:
             raise KeyError(f"object {oid.hex()} not in store")

@@ -10,19 +10,35 @@ node-to-node in chunks when non-local.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.cluster import object_client
+from ray_tpu.cluster import fault_plane, object_client
 from ray_tpu.cluster.node_daemon import CHUNK_SIZE
-from ray_tpu.cluster.protocol import get_client
+from ray_tpu.cluster.protocol import ConnectionLost, RpcError, get_client
 from ray_tpu.core import serialization
 from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
 from ray_tpu.core.ids import ObjectID, store_key
 
 # Batch-get miss marker (a stored value may legitimately be None).
 MISS = object()
+
+logger = logging.getLogger(__name__)
+
+_loc_dropped_counter = None
+
+
+def _count_dropped_registrations(n: int) -> None:
+    global _loc_dropped_counter
+    if _loc_dropped_counter is None:
+        from ray_tpu.util.metrics import Counter
+        _loc_dropped_counter = Counter(
+            "location_registrations_dropped",
+            "Object-location registrations discarded because the batcher's "
+            "buffer overflowed during a conductor outage.")
+    _loc_dropped_counter.inc(n)
 
 
 class _ByteBudget:
@@ -66,6 +82,8 @@ class _LocationBatcher:
         self._lock = threading.Lock()
         self._event = threading.Event()
         self._stopped = False
+        self._drop_logged = False
+        self.dropped_total = 0
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="loc-batch")
         self._thread.start()
@@ -101,10 +119,26 @@ class _LocationBatcher:
                 # 1s instead of hammering at the burst cadence, and bound
                 # the buffer — after reconnection the daemon re-advertises
                 # its whole store inventory anyway, so dropped entries are
-                # recovered by that replay.
+                # recovered by that replay. Dropping is still an eventual-
+                # consistency gamble (a driver-side plane has no inventory
+                # replay), so it must be observable, not silent.
                 backoff = min(backoff * 4, 1.0)
                 with self._lock:
-                    self._buf = (batch + self._buf)[-self._MAX_BUFFER:]
+                    keep = (batch + self._buf)[-self._MAX_BUFFER:]
+                    dropped = len(batch) + len(self._buf) - len(keep)
+                    self._buf = keep
+                if dropped > 0:
+                    self.dropped_total += dropped
+                    _count_dropped_registrations(dropped)
+                    if not self._drop_logged:
+                        self._drop_logged = True
+                        logger.warning(
+                            "location batcher buffer overflow: dropped %d "
+                            "object-location registration(s) while the "
+                            "conductor was unreachable (buffer cap %d); "
+                            "counting further drops in the "
+                            "location_registrations_dropped metric",
+                            dropped, self._MAX_BUFFER)
                 self._event.set()
 
     def flush(self) -> None:
@@ -239,9 +273,19 @@ class ObjectPlane:
         if view is not None:
             return view
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Loss detection: once a locate round ADVERTISED holders and every
+        # pull from them failed definitively (holder unreachable or it
+        # denied having the object), a later round with no live holders
+        # means the object is gone, not merely not-yet-computed — raise
+        # ObjectLostError so callers engage lineage recovery (or surface
+        # the loss) instead of spinning until (or past) their deadline.
+        holders_failed = False
         while True:
             remaining = 2.0 if deadline is None else deadline - time.monotonic()
-            if remaining is not None and remaining <= 0:
+            if remaining <= 0:
+                if holders_failed:
+                    raise ObjectLostError(
+                        oid.hex(), "all advertised holders unreachable")
                 raise GetTimeoutError(
                     f"timed out waiting for object {oid.hex()}")
             loc = self.conductor.call("locate_object", oid=key,
@@ -249,31 +293,64 @@ class ObjectPlane:
             view = self.store.get_pinned(key, timeout=0.0)
             if view is not None:
                 return view
-            for node in loc["nodes"]:
-                if node["node_id"] == self.node_id:
-                    continue
-                if self._pull(key, node["address"]):
+            nodes = [n for n in loc["nodes"]
+                     if n["node_id"] != self.node_id]
+            if loc.get("lost") and not nodes and not loc.get("spilled"):
+                # The directory itself declared the object lost: every
+                # registered copy died with its node (or was removed by a
+                # failed-pull report) and there is no spill. Deterministic
+                # — no need to wait for our own pulls to fail.
+                raise ObjectLostError(
+                    oid.hex(), "directory reports all object copies lost "
+                    "(holder nodes died, no spill copy)")
+            definitive_failures = 0
+            for node in nodes:
+                outcome = self._pull(key, node["address"],
+                                     holder_id=node["node_id"])
+                if outcome == "ok":
                     view = self.store.get_pinned(key, timeout=0.0)
                     if view is not None:
                         return view
+                elif outcome in ("missing", "unreachable"):
+                    definitive_failures += 1
+            if nodes and definitive_failures == len(nodes):
+                holders_failed = True
+            elif not nodes and not loc.get("spilled") and holders_failed:
+                # Every holder we were pointed at failed AND the directory
+                # (now scrubbed of them by _pull's removal reports) lists
+                # none: fully lost. A reconstruction that re-creates the
+                # object registers a new location and wakes the locate
+                # long-poll above before this branch can trigger.
+                raise ObjectLostError(
+                    oid.hex(), "object has no live holders and no spill "
+                    "copy (all advertised replicas failed)")
             # No location known yet (still being computed) -> loop.
 
-    def _pull(self, key: bytes, remote_addr: str) -> bool:
+    def _pull(self, key: bytes, remote_addr: str,
+              holder_id: Optional[bytes] = None) -> str:
         """Chunked pull of one object from a remote daemon into local shm.
 
         Single-flight per object: concurrent getters wait on the same pull.
+        Returns "ok", or a failure class: "missing" (holder denies having
+        it), "unreachable" (holder connection dead), "error" (local/other).
+        missing/unreachable holders are reported to the directory
+        (remove_object_location) so locate rounds — ours and every other
+        node's — stop retrying a replica that cannot serve.
         """
         with self._pull_guard:
             lock = self._pull_locks.setdefault(key, threading.Lock())
         with lock:
             if self.store.contains(key):
-                return True
+                return "ok"
             cli = get_client(remote_addr)
             admitted = 0
+            failure = "error"
             try:
+                fault_plane.fire("object.pull", oid=key)
                 info = cli.call("object_info", oid=key)
                 if not info["found"]:
-                    return False
+                    self._drop_location(key, holder_id)
+                    return "missing"
                 size = info["size"]
                 self._pull_budget.acquire(size)
                 admitted = size
@@ -281,6 +358,8 @@ class ObjectPlane:
                 try:
                     off = 0
                     while off < size:
+                        fault_plane.fire("object.pull.chunk", oid=key,
+                                         offset=off)
                         n = min(CHUNK_SIZE, size - off)
                         chunk = cli.call("fetch_chunk", oid=key,
                                          offset=off, size=n)
@@ -290,15 +369,27 @@ class ObjectPlane:
                 self.store.seal(key)
             except object_client.ObjectStoreError as e:
                 if "already exists" in str(e):
-                    return True
+                    return "ok"
                 raise
+            except (ConnectionError, ConnectionLost, OSError, RpcError):
+                self._drop_location(key, holder_id)
+                return "unreachable"
             except Exception:
-                return False
+                return failure
             finally:
                 if admitted:
                     self._pull_budget.release(admitted)
             self._loc_batcher.add(key)
-            return True
+            return "ok"
+
+    def _drop_location(self, key: bytes, holder_id: Optional[bytes]) -> None:
+        if holder_id is None:
+            return
+        try:
+            self.conductor.call("remove_object_location", oid=key,
+                                node_id=holder_id)
+        except Exception:
+            pass  # directory unreachable; the next locate retries anyway
 
     def free(self, oid: ObjectID) -> None:
         self.conductor.call("free_object", oid=self._key(oid))

@@ -32,42 +32,11 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu.cluster import fault_plane
+
 
 class RpcError(Exception):
     pass
-
-
-_delay_cache: tuple = (-1, None)  # (config generation, cached spec)
-
-
-def _maybe_inject_delay(method: str) -> None:
-    """Deterministic chaos-testing delay (parity: the reference's
-    RAY_testing_asio_delay_us flag, ray_config_def.h:762, used by
-    test_chaos.py to stretch 2PC windows). Set config
-    ``testing_rpc_delay_us`` to "<us>" for all methods or
-    "<method>:<us>[,<method>:<us>...]" to target specific RPCs."""
-    global _delay_cache
-    import time as _time
-
-    from ray_tpu import config as _config
-    gen, spec = _delay_cache
-    if gen != _config.generation:
-        # This runs on EVERY rpc; re-resolving through os.environ each
-        # time measurably drags task throughput. set_system_config bumps
-        # the generation, so chaos tests still flip it mid-run.
-        spec = _config.get("testing_rpc_delay_us")
-        _delay_cache = (_config.generation, spec)
-    if not spec:
-        return
-    spec = str(spec)
-    if ":" in spec:
-        for part in spec.split(","):
-            name, _, us = part.partition(":")
-            if name == method and us.isdigit():
-                _time.sleep(int(us) / 1e6)
-                return
-    elif spec.isdigit() and int(spec):
-        _time.sleep(int(spec) / 1e6)
 
 
 class ConnectionLost(RpcError):
@@ -100,7 +69,11 @@ def _recv_frame(sock: socket.socket) -> bytes:
 def _dispatch(service: Any, method: str, kwargs: dict) -> Tuple[bool, Any]:
     """Resolve and run one method; exceptions become the payload."""
     try:
-        _maybe_inject_delay(method)
+        # Fault point: delay/raise before serving (subsumes the old
+        # _maybe_inject_delay / testing_rpc_delay_us hook). A raise here
+        # ships to the caller as the call's error payload — a handler
+        # failure, not a transport failure.
+        fault_plane.fire("rpc.server.dispatch", method=method)
         if method == "__batch__":
             return True, [_dispatch(service, m, kw)
                           for m, kw in kwargs["calls"]]
@@ -141,9 +114,29 @@ class _Handler(socketserver.BaseRequestHandler):
         with self._send_lock:
             _send_frame(self.request, payload)
 
+    def _sever(self) -> None:
+        try:
+            self.request.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.request.close()
+        except OSError:
+            pass
+
     def _run_pipelined(self, service: Any, seq: int, method: str,
                        kwargs: dict) -> None:
         ok, payload = _dispatch(service, method, kwargs)
+        # Fault point: lose the reply after the handler ran — the
+        # "committed but unacked" window every idempotent/deduped op must
+        # survive. drop_reply loses just this frame; sever kills the whole
+        # connection (and with it every pipelined call in flight).
+        act = fault_plane.fire("rpc.server.reply", method=method)
+        if act == "drop_reply":
+            return
+        if act == "sever":
+            self._sever()
+            return
         try:
             self._respond((seq, ok, payload))
         except OSError:
@@ -179,6 +172,12 @@ class _Handler(socketserver.BaseRequestHandler):
             # Classic frame: dispatch inline (no thread handoff on the
             # latency-critical single-call path).
             resp = _dispatch(service, method, kwargs)
+            act = fault_plane.fire("rpc.server.reply", method=method)
+            if act == "drop_reply":
+                continue
+            if act == "sever":
+                self._sever()
+                return
             try:
                 self._respond(resp)
             except OSError:
@@ -257,6 +256,13 @@ class _PipeChannel:
                 return fut
             self._pending[seq] = fut
         try:
+            # Fault point: client-side loss on the pipelined channel. sever
+            # closes the shared socket, so the send below (or the reader
+            # thread) fails and _fail_all promptly fails EVERY pending
+            # future — the fail-fast contract chaos tests pin down.
+            if fault_plane.fire("rpc.client.send", method=method,
+                                pipelined=True) == "sever":
+                self._sock.close()
             frame = pickle.dumps((seq, method, kwargs), protocol=5)
             with self._send_lock:
                 _send_frame(self._sock, frame)
@@ -392,7 +398,11 @@ class RpcClient:
         try:
             if _timeout is not None:
                 sock.settimeout(_timeout)
+            if fault_plane.fire("rpc.client.send", method=method) == "sever":
+                sock.close()
             _send_frame(sock, pickle.dumps((method, kwargs), protocol=5))
+            if fault_plane.fire("rpc.client.recv", method=method) == "sever":
+                sock.close()  # request sent, reply lost: the unacked window
             ok, payload = pickle.loads(_recv_frame(sock))
             if _timeout is not None:
                 sock.settimeout(self._timeout)
@@ -424,12 +434,69 @@ class RpcClient:
                 self._pipe = _PipeChannel(self._connect())
             return self._pipe
 
-    def call_async(self, method: str, **kwargs) -> Future:
+    def call_async(self, method: str, _retry: bool = False,
+                   **kwargs) -> Future:
         """Pipelined single-attempt call: returns a Future without waiting
         for the round-trip, so N calls overlap on one socket. No automatic
-        resend — a dead channel fails the future with ConnectionLost (use
-        ``call_pipelined`` for the retrying sync flavor)."""
-        return self._channel().request(method, kwargs)
+        resend by default — a severed channel fails the future FAST with
+        ConnectionLost (never hangs; _PipeChannel._fail_all drains every
+        pending future the moment the socket dies).
+
+        ``_retry=True`` opts into async reconnect-and-retry under the same
+        at-least-once contract as ``call``: on ConnectionLost the call is
+        re-sent on a fresh channel (once immediately, then on a 100ms
+        cadence until the reconnect_s window closes). ONLY safe for
+        idempotent ops — conductor mutations dedupe by id, so its control
+        ops qualify; an arbitrary service method may not."""
+        if not _retry:
+            return self._channel().request(method, kwargs)
+        out: Future = Future()
+        deadline = (time.monotonic() + self._reconnect_s
+                    if self._reconnect_s > 0 else None)
+        state = {"fresh_retry_done": False}
+
+        def _issue() -> None:
+            try:
+                self._channel().request(method, kwargs) \
+                    .add_done_callback(_on_done)
+            except BaseException as e:  # noqa: BLE001 - connect failed
+                _on_failure(e)
+
+        def _on_done(fut: Future) -> None:
+            exc = fut.exception()
+            if exc is None:
+                out.set_result(fut.result())
+            elif isinstance(exc, ConnectionLost):
+                _on_failure(exc)
+            else:
+                out.set_exception(exc)
+
+        def _on_failure(exc: BaseException) -> None:
+            if self._closed or (deadline is not None
+                                and time.monotonic() >= deadline and
+                                state["fresh_retry_done"]):
+                out.set_exception(exc if isinstance(exc, ConnectionLost)
+                                  else ConnectionLost(repr(exc)))
+                return
+            if not state["fresh_retry_done"]:
+                # Stale cached channel: one immediate fresh-socket retry
+                # (mirrors call/call_pipelined).
+                state["fresh_retry_done"] = True
+                _issue()
+                return
+            if deadline is None:
+                out.set_exception(exc if isinstance(exc, ConnectionLost)
+                                  else ConnectionLost(repr(exc)))
+                return
+            # Delayed retry off-thread: _on_failure runs on the reader
+            # thread inside _fail_all — sleeping here would stall failing
+            # the channel's other pending futures.
+            t = threading.Timer(0.1, _issue)
+            t.daemon = True
+            t.start()
+
+        _issue()
+        return out
 
     def call_pipelined(self, method: str, _timeout: Optional[float] = None,
                        **kwargs) -> Any:

@@ -23,7 +23,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from ray_tpu.cluster import object_client
+from ray_tpu.cluster import fault_plane, object_client
 from ray_tpu.cluster.object_plane import ObjectPlane
 from ray_tpu.cluster.protocol import RpcServer, get_client
 from ray_tpu.core import serialization
@@ -209,6 +209,10 @@ class WorkerService:
             return
         error = ""
         try:
+            # Fault point: mid-task kill. A "crash" rule here os._exit()s
+            # between dequeue and result-store — the window where only
+            # lineage reconstruction (or task retries) can save the caller.
+            fault_plane.fire("worker.task.exec", name=name)
             fn = self._load_fn(function_id, function_blob)
             args, kwargs = self._resolve(args_blob)
             result = fn(*args, **kwargs)
@@ -366,6 +370,13 @@ class WorkerService:
         def run_sync():
             err = ""
             try:
+                # Fault point: kill/fail mid-actor-task — after the seqno
+                # turn was taken, before the result stores. Exercises the
+                # restart FSM + max_task_retries resubmission. ``method``
+                # is the bare method name (``name`` is module-qualified,
+                # unwieldy for match filters).
+                fault_plane.fire("worker.actor.exec", name=name,
+                                 method=method_name)
                 args, kwargs = self._resolve(args_blob)
                 m = getattr(self.actor_instance, method_name)
                 result = m(*args, **kwargs)
@@ -540,6 +551,13 @@ def main() -> None:
     ap.add_argument("--node-id", required=True)
     ap.add_argument("--token", required=True)
     args = ap.parse_args()
+    # Adopt the parent's system-config overrides (RT_SYSTEM_CONFIG_JSON):
+    # flag changes — including a loaded fault plan — follow the spawn.
+    from ray_tpu import config
+    try:
+        config.load_from_env()
+    except Exception:
+        pass  # an unknown flag from a mismatched parent must not kill boot
     prof = os.environ.get("RTPU_WORKER_STARTUP_PROF")
     marks = [("start", time.perf_counter())]
     node_id = bytes.fromhex(args.node_id)

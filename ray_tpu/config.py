@@ -174,7 +174,16 @@ define("actor_max_restarts_default", int, 0, "Default actor restarts.")
 define("testing_rpc_delay_us", str, "",
        "Deterministic delay injected before serving matching RPCs; format "
        "'method:us' pairs comma-separated, or bare int for all methods "
-       "(reference: RAY_testing_asio_delay_us).")
+       "(reference: RAY_testing_asio_delay_us). Subsumed by the fault "
+       "plane (cluster/fault_plane.py) as delay rules on "
+       "rpc.server.dispatch; kept for compatibility.")
+define("fault_plan", str, "",
+       "JSON list of fault-injection rules evaluated at named fault "
+       "points (cluster/fault_plane.py). Empty = every fault point is a "
+       "no-op. Propagates to spawned daemons/workers like any override.")
+define("fault_seed", int, 0,
+       "Base seed for probabilistic fault-plan rules (per-rule 'seed' "
+       "overrides). Chaos tests print it so failures replay exactly.")
 
 # Transport
 define("rpc_connect_timeout_s", float, 10.0, "Client connect timeout.")

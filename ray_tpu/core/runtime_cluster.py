@@ -1073,7 +1073,7 @@ class ClusterRuntime:
             step = 2.0 if remaining is None else min(2.0, remaining)
             try:
                 value = self.plane.get_value(ref.id, timeout=step)
-            except GetTimeoutError:
+            except (GetTimeoutError, ObjectLostError) as e:
                 waited += step
                 # Object not ready: maybe its actor died, or it was lost
                 # and lineage can reconstruct it.
@@ -1088,6 +1088,14 @@ class ClusterRuntime:
                         raise TaskError.from_exception(
                             ActorDiedError(info.get("class_name", ""),
                                            info.get("death_reason", "")))
+                elif isinstance(e, ObjectLostError):
+                    # Confirmed loss (every holder gone), not a mere stall:
+                    # engage recovery immediately — and if there is no
+                    # lineage to reconstruct from (a put, or an evicted
+                    # record), surface the loss instead of spinning until
+                    # the deadline.
+                    if not self.submitter.try_recover(ref.id):
+                        raise
                 elif waited >= 4.0:
                     # Retry recovery on EVERY stall iteration, not once:
                     # a reconstruction attempt can itself be lost to the

@@ -187,10 +187,21 @@ class BackendExecutor:
             round_reports: List[Optional[dict]] = [None] * len(wg.workers)
             pending = set(range(len(wg.workers)))
             while pending:
-                for rank in list(pending):
+                # Poll the whole round concurrently under ONE shared
+                # deadline: submit every rank's long-poll up front, then
+                # collect. Serial per-rank polling with a fresh 120s get
+                # each meant one hung rank delayed dead-rank detection on
+                # every rank queued behind it by up to 120s apiece. A rank
+                # still training answers "pending" within its 30s
+                # long-poll, re-arming the next wave's deadline — only a
+                # rank that cannot answer at all eats the full window.
+                wave = {rank: wg.workers[rank].next_report.remote(index, 30.0)
+                        for rank in sorted(pending)}
+                wave_deadline = time.monotonic() + 120.0
+                for rank, ref in wave.items():
                     try:
-                        r = rt.get(wg.workers[rank].next_report.remote(
-                            index, 30.0), timeout=120)
+                        r = rt.get(ref, timeout=max(
+                            5.0, wave_deadline - time.monotonic()))
                     except TrainingFailedError:
                         raise
                     except Exception as e:  # noqa: BLE001 - rank died

@@ -20,7 +20,30 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import random  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1's "
+        "-m 'not slow' selection")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection test (cluster/fault_plane.py); "
+        "fast cases run in tier-1, long randomized schedules are also "
+        "marked slow")
+
+
+@pytest.fixture
+def chaos_seed():
+    """Seed for a chaos schedule, printed so the exact run reproduces:
+    pytest -s shows it live, and a FAILED test's captured stdout carries
+    it in the report. Pin with RT_CHAOS_SEED=<n> to replay."""
+    pinned = os.environ.get("RT_CHAOS_SEED")
+    seed = int(pinned) if pinned else random.SystemRandom().randrange(1 << 31)
+    print(f"\n[chaos] seed={seed}  (replay: RT_CHAOS_SEED={seed})")
+    return seed
 
 
 @pytest.fixture

@@ -1,0 +1,520 @@
+"""End-to-end chaos suite for the deterministic fault-injection plane.
+
+Drives cluster/fault_plane.py through every layer it instruments: raw RPC
+(sever / drop-reply / injected raises), the conductor journal (CRC framing,
+torn-tail truncation), the object plane (loss detection, location-batcher
+overflow accounting), and full cluster scenarios — a task wave under a
+seeded kill schedule, lineage reconstruction after node loss, an actor
+gang with restarts + recycled workers, and a 2-worker training run that
+survives a rank kill.
+
+Test-strategy parity: the reference's test_chaos.py / test_failure*.py
+suites, but with the chaos scripted through first-class fault points
+instead of ad-hoc process kills. Every randomized schedule prints its
+seed (chaos_seed fixture); replay with RT_CHAOS_SEED=<n>.
+"""
+
+import concurrent.futures
+import os
+import pickle
+import signal
+import struct
+import threading
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster import fault_plane
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.cluster.protocol import (ConnectionLost, RpcClient, RpcError,
+                                      RpcServer)
+from ray_tpu.core import api as core_api
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    """No fault plan leaks into (or out of) any test in this module."""
+    fault_plane.clear_plan()
+    yield
+    fault_plane.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# Schedules: deterministic by construction
+# ---------------------------------------------------------------------------
+
+
+def test_nth_hit_schedule_is_exact():
+    fault_plane.load_plan(
+        [{"site": "unit.nth", "action": "raise", "nth": 3, "times": 1}])
+    outcomes = []
+    for _ in range(6):
+        try:
+            fault_plane.fire("unit.nth")
+            outcomes.append("ok")
+        except fault_plane.FaultInjected:
+            outcomes.append("boom")
+    assert outcomes == ["ok", "ok", "boom", "ok", "ok", "ok"]
+    assert fault_plane.stats().get("unit.nth") == 1
+
+
+def test_seeded_probability_schedule_replays_exactly(chaos_seed):
+    plan = [{"site": "unit.prob", "action": "raise",
+             "prob": 0.3, "seed": chaos_seed}]
+
+    def run():
+        fault_plane.clear_plan()
+        fault_plane.load_plan(plan, seed=chaos_seed)
+        fired = []
+        for _ in range(300):
+            try:
+                fault_plane.fire("unit.prob")
+                fired.append(False)
+            except fault_plane.FaultInjected:
+                fired.append(True)
+        return fired
+
+    a, b = run(), run()
+    assert a == b, "same plan + same seed must reproduce the same schedule"
+    assert any(a) and not all(a)
+
+
+def test_match_filter_scopes_rule_to_context():
+    fault_plane.load_plan(
+        [{"site": "unit.match", "match": {"method": "fetch"},
+          "action": "raise", "exc": "RuntimeError"}])
+    fault_plane.fire("unit.match", method="ping")  # filtered out: no count
+    with pytest.raises(RuntimeError, match="injected fault"):
+        fault_plane.fire("unit.match", method="fetch")
+
+
+# ---------------------------------------------------------------------------
+# RPC plane: sever / drop-reply semantics (PR 3 pipelined-path regressions)
+# ---------------------------------------------------------------------------
+
+
+class _Svc:
+    def rpc_echo(self, x):
+        return x
+
+    def rpc_slow(self, s):
+        time.sleep(s)
+        return "slow"
+
+
+@pytest.fixture()
+def rpc_pair():
+    srv = RpcServer(_Svc())
+    cli = RpcClient(srv.address)
+    yield srv, cli
+    cli.close()
+    srv.stop()
+
+
+def test_sever_fails_pending_pipelined_futures_fast(rpc_pair):
+    """A severed pipelined socket must fail EVERY in-flight future promptly
+    (< 2s), not leave them hanging until some distant timeout."""
+    _, cli = rpc_pair
+    slow = cli.call_async("slow", s=30.0)  # parked server-side
+    time.sleep(0.1)
+    fault_plane.load_plan(
+        [{"site": "rpc.client.send", "action": "sever", "nth": 1}])
+    t0 = time.monotonic()
+    probe = cli.call_async("echo", x=1)
+    with pytest.raises(ConnectionLost):
+        probe.result(timeout=5)
+    with pytest.raises(ConnectionLost):
+        slow.result(timeout=5)
+    assert time.monotonic() - t0 < 2.0
+    fault_plane.clear_plan()
+    # The channel re-establishes for subsequent traffic.
+    assert cli.call("echo", x=2) == 2
+
+
+def test_call_async_retry_survives_reply_sever(rpc_pair):
+    """Opt-in at-least-once: a reply lost to a dying socket is retried on a
+    fresh channel instead of surfacing ConnectionLost."""
+    _, cli = rpc_pair
+    fault_plane.load_plan(
+        [{"site": "rpc.server.reply", "action": "sever", "nth": 1}])
+    assert cli.call_async("echo", x=7, _retry=True).result(timeout=10) == 7
+
+
+def test_drop_reply_loses_one_reply_channel_survives(rpc_pair):
+    """drop_reply models a lost reply, not a dead peer: only the targeted
+    call hangs (its caller's timeout governs); pipeline-mates complete."""
+    _, cli = rpc_pair
+    fault_plane.load_plan(
+        [{"site": "rpc.server.reply", "action": "drop_reply", "nth": 1}])
+    dropped = cli.call_async("echo", x=1)
+    assert cli.call_async("echo", x=2).result(timeout=5) == 2
+    with pytest.raises(concurrent.futures.TimeoutError):
+        dropped.result(timeout=0.5)
+
+
+def test_classic_call_retries_through_recv_sever(rpc_pair):
+    """The classic per-call path reconnects and retries when its socket is
+    severed between send and recv (at-least-once for idempotent calls) —
+    given a reconnect window, the failover-transparency contract every
+    conductor client runs with."""
+    srv, _ = rpc_pair
+    cli = RpcClient(srv.address, reconnect_s=5.0)
+    try:
+        fault_plane.load_plan(
+            [{"site": "rpc.client.recv", "action": "sever", "nth": 1}])
+        assert cli.call("echo", x=9) == 9
+    finally:
+        cli.close()
+
+
+def test_injected_dispatch_error_propagates_to_caller(rpc_pair):
+    _, cli = rpc_pair
+    fault_plane.load_plan(
+        [{"site": "rpc.server.dispatch", "match": {"method": "slow"},
+          "action": "raise", "exc": "RuntimeError", "every": 1}])
+    assert cli.call("echo", x=1) == 1  # unmatched method unaffected
+    with pytest.raises((RpcError, RuntimeError), match="injected fault"):
+        cli.call("slow", s=0.0)
+    fault_plane.clear_plan()
+    assert cli.call("slow", s=0.0) == "slow"
+
+
+# ---------------------------------------------------------------------------
+# Conductor journal: CRC framing + torn-tail truncation
+# ---------------------------------------------------------------------------
+
+
+def _journal(prefix):
+    from ray_tpu.cluster.persistence import StateJournal
+    return StateJournal(prefix)
+
+
+def test_journal_truncates_torn_tail_and_keeps_appending(tmp_path):
+    prefix = str(tmp_path / "j")
+    j = _journal(prefix)
+    for i in range(10):
+        j.append("op", {"i": i})
+    j.close()
+    # A crash mid-write leaves a torn frame: a header promising more bytes
+    # than the file holds.
+    with open(prefix + ".log", "ab") as f:
+        f.write(b"\x80\x00\x00\x00GARB")
+    j2 = _journal(prefix)
+    _, records = j2.load()
+    assert [d["i"] for k, d in records if k == "op"] == list(range(10))
+    # Post-restore appends extend the good prefix, not the garbage.
+    j2.append("op", {"i": 10})
+    j2.close()
+    j3 = _journal(prefix)
+    _, records = j3.load()
+    assert [d["i"] for _, d in records] == list(range(11))
+    j3.close()
+
+
+def test_journal_crc_catches_bit_flip(tmp_path):
+    prefix = str(tmp_path / "j")
+    j = _journal(prefix)
+    for i in range(5):
+        j.append("op", {"i": i})
+    j.close()
+    # Flip one byte inside the LAST record's body: the CRC must reject it
+    # (a bare length prefix would deserialize garbage or crash replay).
+    size = os.path.getsize(prefix + ".log")
+    with open(prefix + ".log", "r+b") as f:
+        f.seek(size - 3)
+        b = f.read(1)
+        f.seek(size - 3)
+        f.write(bytes([b[0] ^ 0xFF]))
+    j2 = _journal(prefix)
+    _, records = j2.load()
+    assert [d["i"] for _, d in records] == list(range(4))
+    j2.close()
+
+
+def test_journal_reads_and_extends_legacy_format(tmp_path):
+    prefix = str(tmp_path / "legacy")
+    with open(prefix + ".log", "wb") as f:
+        for i in range(3):
+            body = pickle.dumps(("op", {"i": i}))
+            f.write(struct.pack("<I", len(body)) + body)
+    j = _journal(prefix)
+    _, records = j.load()
+    assert [d["i"] for _, d in records] == [0, 1, 2]
+    j.append("op", {"i": 3})  # must match the file's legacy framing
+    j.close()
+    j2 = _journal(prefix)
+    _, records = j2.load()
+    assert [d["i"] for _, d in records] == [0, 1, 2, 3]
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# Object plane: location-batcher overflow accounting
+# ---------------------------------------------------------------------------
+
+
+def test_location_batcher_counts_and_logs_drops():
+    from ray_tpu.cluster import object_plane as op
+
+    class _DownConductor:
+        def call(self, *a, **k):
+            raise ConnectionError("conductor unreachable")
+
+    b = op._LocationBatcher(_DownConductor(), b"node0")
+    b._MAX_BUFFER = 64  # instance override: overflow without 262k adds
+    try:
+        for i in range(512):
+            b.add(i.to_bytes(4, "little"))
+        deadline = time.monotonic() + 10
+        while b.dropped_total == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert b.dropped_total > 0, "overflow past the cap must be counted"
+        assert b._drop_logged, "first drop must be logged"
+        assert len(b._buf) <= 64
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cluster scenarios
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def make_cluster():
+    """Function-scoped cluster factory: chaos tests mutate cluster state
+    (kill workers/nodes, load fault plans), so nothing is shared."""
+    made = []
+
+    def _make(head_args=None, **cluster_kw):
+        c = Cluster(initialize_head=True,
+                    head_node_args=head_args or {"num_cpus": 4},
+                    **cluster_kw)
+        rt_ = ClusterRuntime(address=c.address)
+        core_api._runtime = rt_
+        made.append((c, rt_))
+        return c, rt_
+
+    yield _make
+    fault_plane.clear_plan()
+    for c, rt_ in made:
+        core_api._runtime = None
+        try:
+            rt_.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
+
+
+def test_get_view_raises_object_lost_within_deadline(make_cluster):
+    """A getter pointed at an object whose only holder died must learn
+    "lost" inside its deadline — not spin forever re-polling the
+    directory — so lineage recovery (or the caller) can take over."""
+    import numpy as np
+    c, rt_ = make_cluster(head_args={"num_cpus": 2}, health_timeout_s=2.0)
+    node_b = c.add_node(num_cpus=2, resources={"B": 1.0})
+
+    @rt.remote(resources={"B": 1.0}, num_cpus=1)
+    def big():
+        return np.ones(300_000, dtype=np.uint8)
+
+    ref = big.remote()
+    ready, _ = rt.wait([ref], num_returns=1, timeout=60)
+    assert ready, "producer task did not finish"
+    c.remove_node(node_b, graceful=False)  # crash: only holder gone
+    t0 = time.monotonic()
+    with pytest.raises(rt.ObjectLostError):
+        rt_.plane.get_view(ref.id, timeout=8.0)
+    assert time.monotonic() - t0 <= 8.5
+
+
+def test_task_wave_completes_under_seeded_kill_schedule(make_cluster,
+                                                        chaos_seed):
+    """Scenario 1: every worker crashes hard (os._exit, the preemption
+    stand-in) at the start of its 3rd task, plus seeded control-plane
+    delays — the wave must still complete with correct results via task
+    retry over replacement workers."""
+    make_cluster(head_args={"num_cpus": 4})
+    fault_plane.load_plan(
+        [{"site": "worker.task.exec", "action": "crash",
+          "nth": 3, "times": 1},
+         {"site": "rpc.server.dispatch", "action": "delay",
+          "delay_s": 0.002, "prob": 0.05, "seed": chaos_seed}],
+        seed=chaos_seed)
+
+    # max_retries=-1: under a schedule where EVERY worker crashes once,
+    # how many times a given task lands as some worker's fatal 3rd task is
+    # scheduling-dependent — the budget under test is the plane's ability
+    # to keep resubmitting over replacement workers, not a retry cap.
+    # Progress is guaranteed: a worker that survived its 3rd task
+    # (times: 1) never crashes again.
+    @rt.remote(max_retries=-1)
+    def square(i):
+        time.sleep(0.02)
+        return i * i
+
+    refs = [square.remote(i) for i in range(24)]
+    assert rt.get(refs, timeout=180) == [i * i for i in range(24)]
+
+
+def test_lineage_reconstruction_after_total_node_loss(make_cluster):
+    """Results computed on a node that then dies (taking every copy with
+    it) are reconstructed by re-executing their tasks on new capacity:
+    the directory's lost verdict feeds straight into lineage recovery."""
+    c, _ = make_cluster(head_args={"num_cpus": 0})
+    node_b = c.add_node(num_cpus=4)
+
+    @rt.remote
+    def produce(i):
+        return i * 7
+
+    refs = [produce.remote(i) for i in range(8)]
+    ready, _ = rt.wait(refs, num_returns=len(refs), timeout=60)
+    assert len(ready) == len(refs)
+    c.remove_node(node_b, graceful=False)  # all copies die un-fetched
+    c.add_node(num_cpus=4)                 # fresh capacity for re-execution
+    assert rt.get(refs, timeout=120) == [i * 7 for i in range(8)]
+
+
+def test_actor_gang_restarts_and_recycled_workers(make_cluster):
+    """Scenario 2: each gang actor's worker crashes mid-call on its 5th
+    task; max_restarts + max_task_retries replay the in-flight call on the
+    restarted incarnation. Afterwards the (recycled) workers must serve
+    new actors."""
+    make_cluster(head_args={"num_cpus": 4})
+    fault_plane.load_plan(
+        [{"site": "worker.actor.exec", "match": {"method": "work"},
+          "action": "crash", "nth": 5, "times": 1}])
+
+    @rt.remote(max_restarts=1, max_task_retries=-1)
+    class Gang:
+        def work(self, i):
+            return i * 10, os.getpid()
+
+    actors = [Gang.remote() for _ in range(3)]
+    refs = [(n, i, a.work.remote(i))
+            for n, a in enumerate(actors) for i in range(8)]
+    deadline = time.monotonic() + 180
+    pids = {}
+    for n, i, ref in refs:
+        val, pid = rt.get(ref, timeout=max(
+            10.0, deadline - time.monotonic()))
+        assert val == i * 10
+        pids.setdefault(n, set()).add(pid)
+    # The 5th call crashed each actor's worker: every actor's calls must
+    # span TWO incarnations (proof the schedule fired and restart worked).
+    for n, p in pids.items():
+        assert len(p) == 2, f"actor {n} never restarted (pids {p})"
+    for a in actors:
+        rt.kill(a)
+    fault_plane.clear_plan()
+    time.sleep(0.5)  # let exits recycle workers into the idle pool
+
+    @rt.remote
+    class Check:
+        def ping(self):
+            return "pong"
+
+    fresh = [Check.remote() for _ in range(3)]
+    assert [rt.get(x.ping.remote(), timeout=60) for x in fresh] == \
+        ["pong"] * 3
+
+
+def test_recycled_worker_death_does_not_wedge_idle_pool(make_cluster):
+    """PR 3 regression: a worker that dies AFTER offering itself back to
+    the idle pool (clean actor exit -> recycle) but BEFORE its next lease
+    must be detected at checkout — the next actor lands on a live
+    worker instead of wedging."""
+    make_cluster(head_args={"num_cpus": 4})
+
+    @rt.remote
+    class P:
+        def pid(self):
+            return os.getpid()
+
+    a = P.remote()
+    pid = rt.get(a.pid.remote(), timeout=60)
+    rt.kill(a)          # clean exit: worker recycles into the idle pool
+    time.sleep(0.5)     # let the recycle check-in land
+    try:
+        os.kill(pid, signal.SIGKILL)  # dies while idle, unbeknownst to pool
+    except ProcessLookupError:
+        pass  # already exited: checkout still must survive the stale entry
+    time.sleep(0.2)
+    b = P.remote()
+    assert rt.get(b.pid.remote(), timeout=60) != pid
+
+
+def test_elastic_training_survives_rank_kill(tmp_path, chaos_seed):
+    """Scenario 3: a 2-worker training run loses one rank to SIGKILL at a
+    seeded offset; the gang re-forms from the last checkpoint and finishes
+    every step exactly once past the resume point."""
+    import ray_tpu
+    from ray_tpu.air import (FailureConfig, RunConfig, ScalingConfig)
+    from ray_tpu.train import DataParallelTrainer
+
+    pid_dir = str(tmp_path)
+
+    def _loop(cfg):
+        from ray_tpu.air import session
+        from ray_tpu.air.checkpoint import Checkpoint
+        rank = session.get_world_rank()
+        with open(os.path.join(cfg["pid_dir"], f"rank{rank}.pid"),
+                  "w") as f:
+            f.write(str(os.getpid()))
+        start = 0
+        ck = session.get_checkpoint()
+        if ck is not None:
+            start = ck.to_dict()["step"] + 1
+        for step in range(start, cfg["steps"]):
+            time.sleep(cfg["step_time"])
+            session.report(
+                {"step": step, "world_size": session.get_world_size()},
+                checkpoint=Checkpoint.from_dict({"step": step}))
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4},
+                health_timeout_s=2.0)
+    ray_tpu.init(address=c.address)
+    killed = {}
+
+    def chaos():
+        path = os.path.join(pid_dir, "rank1.pid")
+        deadline = time.time() + 30
+        while not os.path.exists(path) and time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.5 + (chaos_seed % 100) / 100.0)  # seeded kill offset
+        try:
+            pid = int(open(path).read())
+            os.kill(pid, signal.SIGKILL)
+            killed["pid"] = pid
+        except (ValueError, OSError):
+            pass
+
+    try:
+        trainer = DataParallelTrainer(
+            _loop,
+            train_loop_config={"steps": 25, "step_time": 0.1,
+                               "pid_dir": pid_dir},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         cpus_per_worker=1.0),
+            run_config=RunConfig(
+                failure_config=FailureConfig(max_failures=3)))
+        killer = threading.Thread(target=chaos, daemon=True)
+        killer.start()
+        result = trainer.fit()
+        assert result.error is None, f"training failed: {result.error}"
+        assert result.metrics["step"] == 24
+        assert result.metrics["world_size"] == 2
+        assert killed.get("pid"), "chaos thread never landed its kill"
+        # Resumed from a checkpoint: at most one restart in the history.
+        steps = [m["step"] for m in result.metrics_history]
+        restarts = sum(1 for i in range(1, len(steps))
+                       if steps[i] <= steps[i - 1])
+        assert restarts <= 1
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
