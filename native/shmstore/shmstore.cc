@@ -59,6 +59,7 @@ enum Op : uint8_t {
   OP_GET_COPY = 9,  // small-object fast path: data inline, no refcount
   OP_PUT_INLINE = 10,    // create+write+seal in ONE round trip
   OP_GET_COPY_BATCH = 11,  // N inline gets in ONE round trip
+  OP_CONTAINS_BATCH = 12,  // N existence checks in ONE round trip
 };
 
 enum Status : uint8_t {
@@ -658,6 +659,23 @@ class Server {
       std::string out;
       for (auto& [id, o] : store_->objects_)
         if (o.state != CREATED && !o.pending_delete) out.append(id.b, 16);
+      return reply(fd, ST_OK, out.data(), (uint32_t)out.size());
+    }
+    if (op == OP_CONTAINS_BATCH) {
+      // [op][count:u32][16B x count] -> ST_OK + one byte (1/0) per id.
+      // Same sealed-and-not-pending-delete predicate as OP_CONTAINS; a
+      // wait() over N refs costs one round trip instead of N.
+      if (len < 5) return reply(fd, ST_ERR);
+      uint32_t count;
+      memcpy(&count, p + 1, 4);
+      if (len < 5 + (uint64_t)count * 16) return reply(fd, ST_ERR);
+      std::string out;
+      out.reserve(count);
+      for (uint32_t k = 0; k < count; k++) {
+        ObjectId bid;
+        memcpy(bid.b, p + 5 + k * 16, 16);
+        out.push_back(store_->contains(bid) ? 1 : 0);
+      }
       return reply(fd, ST_OK, out.data(), (uint32_t)out.size());
     }
     if (len < 17) return reply(fd, ST_ERR);

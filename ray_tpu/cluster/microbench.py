@@ -235,6 +235,7 @@ def main(argv=None) -> int:
 
         per, _ = timed(wait_1k, min_time=1.0 * scale, min_iters=2)
         results["wait_1k_refs_per_sec"] = round(1 / per, 2)
+        del refs
 
         # -- scheduler drain: queue 2k tasks at once ------------------
         settle()
@@ -243,6 +244,90 @@ def main(argv=None) -> int:
         ray_tpu.get([nop.remote() for _ in range(n_q)])
         results["queued_tasks_drained_per_sec"] = round(
             n_q / (time.perf_counter() - t0), 1)
+
+        # -- node-to-node pull bandwidth (100MB) ----------------------
+        # LAST: these add peer nodes, which would change the placement
+        # topology the families above are measured on.
+        # A second node's ObjectPlane pulls a head-held object into its
+        # own store: the full probe + windowed multi-chunk transfer path.
+        # Reported twice: the default config (same-host daemons take the
+        # shm-direct segment copy) and the chunked-TCP path that
+        # cross-host pulls use (object_pull_shm_direct off).
+        settle()
+        from ray_tpu import config
+        from ray_tpu.core import api as core_api
+        from ray_tpu.cluster.object_plane import ObjectPlane
+
+        rt = core_api._runtime
+        peers = [c.add_node(num_cpus=1, object_store_bytes=512 << 20)
+                 for _ in range(4)]
+        c.wait_for_nodes(5)
+        planes = [ObjectPlane(n.store, n.node_id, c.address)
+                  for n in peers]
+
+        def pull_100mb_best() -> float:
+            times = []
+            for _ in range(5):
+                ref = ray_tpu.put(big)
+                key = rt.plane._key(ref.id)
+                t0 = time.perf_counter()
+                out = planes[0]._pull(key, rt.daemon_address)
+                times.append(time.perf_counter() - t0)
+                assert out == "ok", out
+                peers[0].store.delete(key)
+                del ref
+            return min(times)
+
+        results["pull_remote_100mb_gb_per_sec"] = round(
+            0.1 / pull_100mb_best(), 2)
+        config.set_override("object_pull_shm_direct", False)
+        results["pull_remote_100mb_tcp_gb_per_sec"] = round(
+            0.1 / pull_100mb_best(), 2)
+        config.clear_override("object_pull_shm_direct")
+        # Serial chunk loop measured on this host immediately before the
+        # windowed/striped/direct rebuild — the r08 acceptance baseline.
+        results["pull_remote_100mb_serial_baseline_gb_per_sec"] = 0.45
+
+        # -- 4-way broadcast (64MB to 4 nodes concurrently) -----------
+        # Pullers locate via the directory; mid-transfer registration
+        # lets late pullers read from early completers instead of all
+        # four piling on the origin (implicit broadcast tree).
+        settle()
+        big64 = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+
+        def bcast_64mb():
+            import threading as _threading
+            ref = ray_tpu.put(big64)
+            views = [None] * len(planes)
+
+            def one(i):
+                views[i] = planes[i].get_view(ref.id, timeout=60)
+
+            ts = [_threading.Thread(target=one, args=(i,))
+                  for i in range(len(planes))]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            # views hold the serialized blob (header + buffer), so >= raw.
+            assert all(v is not None and v.nbytes >= big64.nbytes
+                       for v in views)
+            key = rt.plane._key(ref.id)
+            del views
+            for n in peers:
+                try:
+                    n.store.delete(key)
+                except Exception:
+                    pass
+            del ref
+            return dt
+
+        dt = min(bcast_64mb() for _ in range(3))
+        results["broadcast_64mb_4way_gb_per_sec"] = round(
+            len(planes) * 0.064 / dt, 2)
+
     finally:
         ray_tpu.shutdown()
         c.shutdown()

@@ -15,6 +15,7 @@ way the raylet colocates plasma (plasma/store_runner.cc).
 from __future__ import annotations
 
 import os
+import pickle
 import signal
 import subprocess
 import sys
@@ -220,6 +221,21 @@ class NodeDaemon:
         # In-progress sender-initiated pushes (push_manager.h receive side).
         self._push_partial: Dict[bytes, dict] = {}
         self._push_lock = threading.Lock()
+        # Chunk-serve load counters, piggybacked on object_info so pullers
+        # spread a broadcast across the least-loaded holders.
+        self._serve_lock = threading.Lock()
+        self._serving_chunks = 0   # fetch_chunk handlers in flight
+        self._served_chunks = 0    # cumulative chunks served
+        # Chunk-serve view cache: oid -> [pinned view, last_use]. A 100MB
+        # pull fetches ~13 chunks; re-running get_pinned per chunk costs a
+        # store round trip + a fresh 100MB mmap + its page-fault storm
+        # each time. Entries idle >5s are dropped by the reap loop (the
+        # pin releases once the last reply frame holding a slice is GC'd).
+        self._serve_views: Dict[bytes, list] = {}
+        # Remote pins taken by same-host shm-direct pulls: oid -> [count,
+        # last_touch]. Reaped after 60s so a crashed puller can't block
+        # deletion/recycling of the segment forever.
+        self._remote_pins: Dict[bytes, list] = {}
         self.server = RpcServer(self, host=host)
         self.address = self.server.address
         reg = get_client(conductor_address).call(
@@ -708,6 +724,23 @@ class NodeDaemon:
                     self.store.delete(oid)
                 except Exception:
                     pass
+            # Idle chunk-serve views: dropping the entry lets the pinned
+            # mapping GC (the finalize queues the store release), so the
+            # object becomes deletable/evictable again.
+            with self._serve_lock:
+                now = time.monotonic()
+                for oid in [o for o, e in self._serve_views.items()
+                            if now - e[1] > 5.0]:
+                    self._serve_views.pop(oid, None)
+                leaked = [o for o, e in self._remote_pins.items()
+                          if now - e[1] > 60.0]
+                for oid in leaked:
+                    self._remote_pins.pop(oid, None)
+            for oid in leaked:  # puller died mid shm-direct copy
+                try:
+                    self.store.release(oid)
+                except Exception:
+                    pass
             dead: List[_Worker] = []
             with self._lock:
                 for w in list(self._workers.values()):
@@ -1159,40 +1192,105 @@ class NodeDaemon:
             return {"found": False, "size": 0}
         size = view.nbytes
         self.store.release(oid)
-        return {"found": True, "size": size}
+        # transfers/served: this daemon's chunk-serve load, so pullers pick
+        # the least-loaded holder (object_manager location-spread role).
+        # shm_path: same-host pullers copy the segment directly instead of
+        # streaming chunks (object_pull_shm_direct).
+        return {"found": True, "size": size,
+                "transfers": self._serving_chunks,
+                "served": self._served_chunks,
+                "shm_path": self.store._shm_path(oid)}
 
-    def rpc_fetch_chunk(self, oid: bytes, offset: int, size: int) -> bytes:
-        fault_plane.fire("daemon.chunk.serve", oid=oid, offset=offset)
+    def rpc_pin_object(self, oid: bytes) -> dict:
+        """Hold a store reference on behalf of a same-host shm-direct
+        puller, so the segment cannot be deleted or recycled while the
+        puller copies it. Balanced by unpin_object; leaked pins (puller
+        died mid-copy) are reaped after 60s."""
+        with self._serve_lock:
+            ent = self._remote_pins.get(oid)
+            if ent is not None:
+                ent[0] += 1
+                ent[1] = time.monotonic()
+                return {"ok": True}
         view = self.store.get(oid, timeout=0.0)
         if view is None:
-            raise KeyError(f"object {oid.hex()} not in store")
+            return {"ok": False}
+        with self._serve_lock:
+            ent = self._remote_pins.get(oid)
+            if ent is None:
+                self._remote_pins[oid] = [1, time.monotonic()]
+                return {"ok": True}
+            ent[0] += 1
+            ent[1] = time.monotonic()
+        self.store.release(oid)  # the existing entry's ref covers us
+        return {"ok": True}
+
+    def rpc_unpin_object(self, oid: bytes) -> dict:
+        with self._serve_lock:
+            ent = self._remote_pins.get(oid)
+            if ent is None:
+                return {"ok": False}
+            ent[0] -= 1
+            if ent[0] > 0:
+                return {"ok": True}
+            self._remote_pins.pop(oid, None)
+        self.store.release(oid)
+        return {"ok": True}
+
+    def rpc_fetch_chunk(self, oid: bytes, offset: int, size: int):
+        fault_plane.fire("daemon.chunk.serve", oid=oid, offset=offset)
+        with self._serve_lock:
+            self._serving_chunks += 1
+            ent = self._serve_views.get(oid)
+            view = None
+            if ent is not None:
+                ent[1] = time.monotonic()
+                view = ent[0]
         try:
-            return bytes(view[offset:offset + size])
+            if view is None:
+                view = self.store.get_pinned(oid, timeout=0.0)
+                if view is None:
+                    raise KeyError(f"object {oid.hex()} not in store")
+                with self._serve_lock:
+                    if oid not in self._serve_views \
+                            and len(self._serve_views) < 8:
+                        self._serve_views[oid] = [view, time.monotonic()]
+            # Zero-copy serve: the RPC reply's out-of-band frame path
+            # sendmsg()s straight from the pinned shm mapping — no bytes()
+            # materialization per chunk. The pin releases when the reply
+            # frame (and its view) is garbage collected after send.
+            buf = pickle.PickleBuffer(view[offset:offset + size])
+            with self._serve_lock:
+                self._served_chunks += 1
+            return buf
         finally:
-            self.store.release(oid)
+            with self._serve_lock:
+                self._serving_chunks -= 1
 
     def rpc_push_chunk(self, oid: bytes, offset: int, total: int,
                        chunk: bytes, stream: Optional[str] = None) -> dict:
         """Receive one chunk of a sender-initiated push (push_manager.h
-        role). Chunks arrive in order on one connection; the first chunk
-        creates the buffer, the last seals + registers the location. Each
-        push carries a sender-generated ``stream`` id: a chunk from a
-        DIFFERENT stream than the in-progress one is rejected without
-        touching that push (two senders racing must not destroy each
-        other's partial writes). A concurrent local pull of the same object
-        wins ties (create raises already-exists → reject the push; pull is
-        the correctness path)."""
+        role). The sender keeps a WINDOW of chunks pipelined, and the
+        server dispatches pipelined frames on a pool — so chunks of one
+        stream legally arrive OUT OF ORDER. The first to arrive creates
+        the buffer; completion is by byte count, and the completing chunk
+        seals + registers the location. Each push carries a
+        sender-generated ``stream`` id: a chunk from a DIFFERENT stream
+        than the in-progress one is rejected without touching that push
+        (two senders racing must not destroy each other's partial writes).
+        A concurrent local pull of the same object wins ties (create
+        raises already-exists → reject the push; pull is the correctness
+        path)."""
         with self._push_lock:  # guards the dict only — never I/O
             st = self._push_partial.get(oid)
             if st is None:
-                if offset != 0:
-                    return {"reject": True}  # stale resumed push
                 # Claim the oid with an empty entry; the store create
                 # happens below, outside this lock (store I/O must not
                 # serialize every concurrent push through one mutex).
                 st = self._push_partial[oid] = {
-                    "buf": None, "off": 0, "total": total, "stream": stream,
-                    "ts": time.monotonic(), "lock": threading.Lock()}
+                    "buf": None, "got": set(), "bytes": 0, "total": total,
+                    "stream": stream, "ts": time.monotonic(),
+                    "lock": threading.Lock()}
             elif st.get("stream") != stream:
                 return {"reject": True}  # another sender's push in progress
         with st["lock"]:
@@ -1207,18 +1305,12 @@ class NodeDaemon:
                     with self._push_lock:
                         self._push_partial.pop(oid, None)
                     return {"done": True}  # being written by a pull
-            if st["total"] == total and offset + len(chunk) <= st["off"]:
-                # Duplicate of an already-applied chunk: the RPC layer's
-                # at-least-once retry resent a chunk whose ack was lost.
-                # Ack idempotently — aborting here would destroy our own
-                # push.
-                return {"ok": True}
-            if offset != st["off"] or st["total"] != total:
-                # Out-of-sequence WITHIN one stream (sender died and
-                # resumed under the same id): abort the push and DELETE the
-                # unsealed entry — an orphaned CREATED object would wedge
-                # every future pull (create→already-exists, get→never
-                # sealed).
+            if st["total"] != total:
+                # Same stream claims a different object size (sender died
+                # and resumed under the same id): abort the push and
+                # DELETE the unsealed entry — an orphaned CREATED object
+                # would wedge every future pull (create→already-exists,
+                # get→never sealed).
                 with self._push_lock:
                     self._push_partial.pop(oid, None)
                 st["buf"].close()
@@ -1227,10 +1319,17 @@ class NodeDaemon:
                 except Exception:
                     pass
                 return {"reject": True}
+            if offset in st["got"]:
+                # Duplicate of an already-applied chunk: the RPC layer's
+                # at-least-once retry resent a chunk whose ack was lost.
+                # Ack idempotently — aborting here would destroy our own
+                # push.
+                return {"ok": True}
             st["buf"].write_at(offset, chunk)
-            st["off"] += len(chunk)
+            st["got"].add(offset)
+            st["bytes"] += len(chunk)
             st["ts"] = time.monotonic()
-            if st["off"] < total:
+            if st["bytes"] < total:
                 return {"ok": True}
             with self._push_lock:
                 self._push_partial.pop(oid, None)

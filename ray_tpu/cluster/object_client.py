@@ -38,7 +38,8 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_STATS, \
-    OP_LIST, OP_GET_COPY, OP_PUT_INLINE, OP_GET_COPY_BATCH = range(1, 12)
+    OP_LIST, OP_GET_COPY, OP_PUT_INLINE, OP_GET_COPY_BATCH, \
+    OP_CONTAINS_BATCH = range(1, 13)
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_OOM, ST_TIMEOUT, ST_ERR, ST_NOT_SEALED, \
     ST_BUSY = range(8)
 
@@ -497,6 +498,22 @@ class ShmClient:
     def contains(self, oid: bytes) -> bool:
         resp = self._call(struct.pack("<B16s", OP_CONTAINS, oid))
         return resp[0] == ST_OK
+
+    def contains_batch(self, oids: List[bytes]) -> List[bool]:
+        """Existence of MANY objects in few round trips — same sealed-and-
+        visible predicate as contains(). Turns a wait() over 1k refs into
+        one store round trip instead of 1k."""
+        out: List[bool] = []
+        for start in range(0, len(oids), self._GET_BATCH):
+            chunk = oids[start:start + self._GET_BATCH]
+            payload = struct.pack("<BI", OP_CONTAINS_BATCH,
+                                  len(chunk)) + b"".join(chunk)
+            resp = self._call(payload)
+            if resp[0] != ST_OK:
+                raise ObjectStoreError(
+                    f"contains_batch failed: status {resp[0]}")
+            out.extend(b != 0 for b in resp[1:1 + len(chunk)])
+        return out
 
     def stats(self) -> dict:
         import json

@@ -11,12 +11,18 @@ node-to-node in chunks when non-local.
 from __future__ import annotations
 
 import logging
+import mmap
+import os
+import random
 import threading
 import time
+from collections import deque
+from concurrent.futures import FIRST_COMPLETED
+from concurrent.futures import TimeoutError as _FutureTimeout
+from concurrent.futures import wait as _futures_wait
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.cluster import fault_plane, object_client
-from ray_tpu.cluster.node_daemon import CHUNK_SIZE
 from ray_tpu.cluster.protocol import ConnectionLost, RpcError, get_client
 from ray_tpu.core import serialization
 from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
@@ -45,18 +51,29 @@ class _ByteBudget:
     """Admission control for concurrent pulls (pull_manager.h:52 role):
     bounds total in-flight pull bytes so N parallel fetches of large
     objects can't blow the local store. An oversized single request is
-    admitted alone (never deadlocks)."""
+    admitted alone (never deadlocks).
+
+    Waiters admit in FIFO order: only the head of the queue may take
+    budget, so a large pull gets the next big-enough window instead of
+    being starved forever by a stream of small requests slipping past it.
+    """
 
     def __init__(self, cap: int):
         self.cap = cap
         self._used = 0
         self._cv = threading.Condition()
+        self._queue: "deque[object]" = deque()
 
     def acquire(self, n: int) -> None:
+        ticket = object()
         with self._cv:
-            while self._used > 0 and self._used + n > self.cap:
+            self._queue.append(ticket)
+            while self._queue[0] is not ticket or \
+                    (self._used > 0 and self._used + n > self.cap):
                 self._cv.wait(1.0)
+            self._queue.popleft()
             self._used += n
+            self._cv.notify_all()  # the next head may also fit
 
     def release(self, n: int) -> None:
         with self._cv:
@@ -213,12 +230,17 @@ class ObjectPlane:
     def put_blob(self, oid: ObjectID, blob: bytes) -> int:
         key = self._key(oid)
         try:
-            w = self.store.create_writer(key, len(blob))
-            try:
-                w.write_at(0, blob)
-            finally:
-                w.close()
-            self.store.seal(key)
+            if len(blob) <= 64 << 10:
+                # Same one-round-trip create+copy+seal fast path as
+                # put_value (raw puts and spill restores are often small).
+                self.store.put_inline(key, blob)
+            else:
+                w = self.store.create_writer(key, len(blob))
+                try:
+                    w.write_at(0, blob)
+                finally:
+                    w.close()
+                self.store.seal(key)
         except object_client.ObjectStoreError as e:
             if "already exists" not in str(e):
                 raise
@@ -238,6 +260,15 @@ class ObjectPlane:
             # test killed it): "not present locally" is the right answer —
             # readers fall back to the object directory / recovery.
             return False
+
+    def contains_batch(self, oids: List[ObjectID]) -> List[bool]:
+        """Readiness of many refs in ONE store round trip (the wait() fast
+        path); falls back per-ref against a daemon that predates the op."""
+        try:
+            return self.store.contains_batch([self._key(o) for o in oids])
+        except (object_client.ObjectStoreError, BrokenPipeError,
+                ConnectionError, OSError):
+            return [self.contains(o) for o in oids]
 
     def get_values_local_inline(self, oids: List[ObjectID]) -> List[Any]:
         """Batch fast path for ray_tpu.get() over many refs: ONE store
@@ -303,23 +334,22 @@ class ObjectPlane:
                 raise ObjectLostError(
                     oid.hex(), "directory reports all object copies lost "
                     "(holder nodes died, no spill copy)")
-            definitive_failures = 0
-            for node in nodes:
-                outcome = self._pull(key, node["address"],
-                                     holder_id=node["node_id"])
+            if nodes:
+                # ONE striped/windowed pull covers every advertised holder
+                # (probe, pick sources, fail over internally).
+                outcome = self._pull_from(key, nodes)
                 if outcome == "ok":
                     view = self.store.get_pinned(key, timeout=0.0)
                     if view is not None:
                         return view
                 elif outcome in ("missing", "unreachable"):
-                    definitive_failures += 1
-            if nodes and definitive_failures == len(nodes):
-                holders_failed = True
-            elif not nodes and not loc.get("spilled") and holders_failed:
+                    # Every probed holder failed definitively.
+                    holders_failed = True
+            elif not loc.get("spilled") and holders_failed:
                 # Every holder we were pointed at failed AND the directory
-                # (now scrubbed of them by _pull's removal reports) lists
-                # none: fully lost. A reconstruction that re-creates the
-                # object registers a new location and wakes the locate
+                # (now scrubbed of them by the pull's removal reports)
+                # lists none: fully lost. A reconstruction that re-creates
+                # the object registers a new location and wakes the locate
                 # long-poll above before this branch can trigger.
                 raise ObjectLostError(
                     oid.hex(), "object has no live holders and no spill "
@@ -328,59 +358,282 @@ class ObjectPlane:
 
     def _pull(self, key: bytes, remote_addr: str,
               holder_id: Optional[bytes] = None) -> str:
-        """Chunked pull of one object from a remote daemon into local shm.
+        """Single-source pull (compat shim over _pull_from): one holder,
+        no striping. Benchmarks use it to measure the raw per-link path."""
+        return self._pull_from(
+            key, [{"address": remote_addr, "node_id": holder_id}])
 
-        Single-flight per object: concurrent getters wait on the same pull.
-        Returns "ok", or a failure class: "missing" (holder denies having
-        it), "unreachable" (holder connection dead), "error" (local/other).
-        missing/unreachable holders are reported to the directory
-        (remove_object_location) so locate rounds — ours and every other
-        node's — stop retrying a replica that cannot serve.
+    def _pull_from(self, key: bytes, nodes: List[dict]) -> str:
+        """Windowed, multi-source chunked pull of one object into local shm
+        (pull_manager.h chunk-window + location-striping roles).
+
+        ``nodes`` are the advertised non-local holders ({"node_id",
+        "address"}). Single-flight per object: concurrent getters wait on
+        the same pull. Probes every holder concurrently (object_info
+        doubles as liveness check and load report), stripes the chunk
+        ranges across up to object_pull_max_sources of the least-loaded
+        holders for large objects, keeps object_pull_window fetch_chunk
+        futures pipelined, writes completions out of order, and reassigns
+        a failed holder's remaining chunks to the survivors.
+
+        Returns "ok", or a failure class: "missing" (holders deny having
+        it), "unreachable" (holder connections dead), "error"
+        (local/other). missing/unreachable holders are reported to the
+        directory (remove_object_location) so locate rounds — ours and
+        every other node's — stop retrying replicas that cannot serve.
         """
         with self._pull_guard:
             lock = self._pull_locks.setdefault(key, threading.Lock())
         with lock:
             if self.store.contains(key):
                 return "ok"
-            cli = get_client(remote_addr)
             admitted = 0
-            failure = "error"
+            created = False
             try:
                 fault_plane.fire("object.pull", oid=key)
-                info = cli.call("object_info", oid=key)
-                if not info["found"]:
-                    self._drop_location(key, holder_id)
-                    return "missing"
-                size = info["size"]
+                holders, size, any_unreachable = self._probe_holders(
+                    key, nodes)
+                if not holders:
+                    return "unreachable" if any_unreachable else "missing"
+                sources = self._select_sources(holders, size)
                 self._pull_budget.acquire(size)
                 admitted = size
                 w = self.store.create_writer(key, size)
+                created = True
                 try:
-                    off = 0
-                    while off < size:
-                        fault_plane.fire("object.pull.chunk", oid=key,
-                                         offset=off)
-                        n = min(CHUNK_SIZE, size - off)
-                        chunk = cli.call("fetch_chunk", oid=key,
-                                         offset=off, size=n)
-                        off += w.write_at(off, chunk)
+                    if self._shm_direct(key, w, size, holders):
+                        outcome = "ok"
+                    else:
+                        outcome = self._run_transfer(key, w, size, sources)
                 finally:
                     w.close()
+                if outcome != "ok":
+                    self._discard_partial(key)
+                    return outcome
                 self.store.seal(key)
             except object_client.ObjectStoreError as e:
                 if "already exists" in str(e):
                     return "ok"
+                if created:
+                    self._discard_partial(key)
                 raise
             except (ConnectionError, ConnectionLost, OSError, RpcError):
-                self._drop_location(key, holder_id)
+                if created:
+                    self._discard_partial(key)
                 return "unreachable"
             except Exception:
-                return failure
+                if created:
+                    self._discard_partial(key)
+                return "error"
             finally:
                 if admitted:
                     self._pull_budget.release(admitted)
             self._loc_batcher.add(key)
             return "ok"
+
+    def _probe_holders(self, key: bytes, nodes: List[dict]):
+        """Concurrent object_info probe of every advertised holder ->
+        ([(node, client, transfer load)], size, any_unreachable). Holders
+        that deny the object or whose connection is dead are reported to
+        the directory."""
+        probes = []
+        for node in nodes:
+            cli = get_client(node["address"])
+            try:
+                # _retry=True: one immediate fresh-channel resend if the
+                # cached pipelined channel went stale (same at-least-once
+                # contract as call(); object_info is a pure read).
+                fut = cli.call_async("object_info", oid=key, _retry=True)
+            except Exception:  # noqa: BLE001 - connect failed
+                fut = None
+            probes.append((node, cli, fut))
+        holders = []
+        size = 0
+        any_unreachable = False
+        for node, cli, fut in probes:
+            try:
+                if fut is None:
+                    raise ConnectionLost("connect failed")
+                info = fut.result(timeout=10.0)
+            except (ConnectionError, ConnectionLost, OSError, RpcError,
+                    _FutureTimeout):
+                any_unreachable = True
+                self._drop_location(key, node["node_id"])
+                continue
+            if not info.get("found"):
+                self._drop_location(key, node["node_id"])
+                continue
+            size = info["size"]
+            holders.append((node, cli, info.get("transfers", 0),
+                            info.get("shm_path")))
+        return holders, size, any_unreachable
+
+    def _select_sources(self, holders: list, size: int) -> list:
+        """Least-loaded holder choice with random tie-break (load-spread:
+        a broadcast wave fans out over fresh copies instead of piling on
+        the origin); large objects take several sources for striping."""
+        from ray_tpu import config
+        random.shuffle(holders)
+        holders.sort(key=lambda h: h[2])  # stable: ties stay shuffled
+        if size >= int(config.get("object_stripe_min_bytes")) \
+                and len(holders) > 1:
+            return holders[:max(1, int(config.get(
+                "object_pull_max_sources")))]
+        return holders[:1]
+
+    def _shm_direct(self, key: bytes, w: object_client.ShmWriter,
+                    size: int, holders: list) -> bool:
+        """Same-host fast path: when a holder daemon shares this machine,
+        its segment file is visible in our /dev/shm — pin it remotely,
+        then copy mapping-to-mapping (one memcpy at memory bandwidth,
+        ~4x the TCP chunk path on loopback). The pin keeps the segment
+        from being deleted or recycled under the copy; any failure falls
+        back to the chunked transfer. Parity: plasma's same-node
+        zero-copy sharing (Ray never streams between co-located object
+        managers)."""
+        from ray_tpu import config
+        if size == 0 or not config.get("object_pull_shm_direct"):
+            return False
+        for node, cli, _load, path in holders:
+            if not path:
+                continue
+            try:
+                if os.stat(path).st_size != size:
+                    continue  # another host's coincidental segment name
+            except OSError:
+                continue
+            pinned = False
+            fd = -1
+            try:
+                if not cli.call("pin_object", oid=key).get("ok"):
+                    continue
+                pinned = True
+                fd = os.open(path, os.O_RDONLY)
+                if os.fstat(fd).st_size != size:
+                    continue
+                mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+                mv = memoryview(mm)
+                try:
+                    w.write_at(0, mv)
+                finally:
+                    mv.release()
+                    mm.close()
+                return True
+            except Exception:  # noqa: BLE001 - fall back to chunked pull
+                continue
+            finally:
+                if fd >= 0:
+                    os.close(fd)
+                if pinned:
+                    try:
+                        cli.call("unpin_object", oid=key)
+                    except Exception:
+                        pass
+        return False
+
+    def _run_transfer(self, key: bytes, w: object_client.ShmWriter,
+                      size: int, sources: list) -> str:
+        """Windowed multi-source chunk loop -> "ok" | failure class.
+
+        Chunk offsets are striped round-robin across the sources; up to
+        object_pull_window fetch_chunk futures stay in flight on the
+        pipelined channels and completions land in the writer OUT OF
+        ORDER (write_at takes any offset). When a source fails its queued
+        chunks re-stripe over the survivors; with no survivors the pull
+        fails with the strongest failure class seen."""
+        from ray_tpu import config
+        if size == 0:
+            return "ok"
+        chunk_bytes = max(1, int(config.get("object_transfer_chunk_bytes")))
+        window = max(1, int(config.get("object_pull_window")))
+        live = {i: src for i, src in enumerate(sources)}
+        pending: Dict[int, deque] = {i: deque() for i in live}
+        for j, off in enumerate(range(0, size, chunk_bytes)):
+            pending[j % len(sources)].append(off)
+        inflight: Dict[Any, Tuple[int, int]] = {}  # future -> (src, offset)
+        remaining = sum(len(q) for q in pending.values())
+        any_unreachable = any_missing = False
+
+        def _kill_source(i: int, exc: Optional[BaseException]) -> None:
+            nonlocal any_unreachable, any_missing
+            node, _cli, _load, _path = live.pop(i)
+            if isinstance(exc, (ConnectionError, ConnectionLost, OSError,
+                                RpcError, _FutureTimeout)):
+                any_unreachable = True
+            elif isinstance(exc, KeyError):
+                any_missing = True  # holder dropped the object mid-pull
+            self._drop_location(key, node["node_id"])
+            orphans = pending.pop(i, deque())
+            if live:
+                order = list(live)
+                for j, off in enumerate(orphans):
+                    pending[order[j % len(order)]].append(off)
+
+        def _issue_one() -> bool:
+            # Round-robin over live sources with queued work; False when
+            # nothing is issuable (window fills stop at remaining work).
+            for i in sorted(live, key=lambda i: len(pending[i]),
+                            reverse=True):
+                if not pending[i]:
+                    continue
+                off = pending[i].popleft()
+                node, cli, _load, _path = live[i]
+                try:
+                    fault_plane.fire("object.pull.chunk", oid=key,
+                                     offset=off)
+                    act = fault_plane.fire(
+                        "object.pull.window", oid=key, offset=off,
+                        holder=node["address"])
+                    if act == "sever":
+                        cli.sever_pipe()
+                    fut = cli.call_async(
+                        "fetch_chunk", oid=key, offset=off,
+                        size=min(chunk_bytes, size - off))
+                except BaseException as e:  # noqa: BLE001
+                    pending[i].appendleft(off)
+                    _kill_source(i, e)
+                    return bool(live)
+                inflight[fut] = (i, off)
+                return True
+            return False
+
+        while remaining:
+            while len(inflight) < window and _issue_one():
+                pass
+            if not inflight:
+                # Sources exhausted with chunks still owed.
+                break
+            done, _ = _futures_wait(inflight, timeout=30.0,
+                                    return_when=FIRST_COMPLETED)
+            if not done:
+                return "error"  # stalled transfer: no completion in 30s
+            for fut in done:
+                i, off = inflight.pop(fut)
+                try:
+                    chunk = fut.result()
+                except BaseException as e:  # noqa: BLE001
+                    if i in live:
+                        _kill_source(i, e)
+                    if live:
+                        order = sorted(live, key=lambda k: len(pending[k]))
+                        pending[order[0]].append(off)
+                    continue
+                w.write_at(off, chunk)
+                remaining -= 1
+        if remaining:
+            if any_unreachable:
+                return "unreachable"
+            return "missing" if any_missing else "error"
+        return "ok"
+
+    def _discard_partial(self, key: bytes) -> None:
+        # A failed pull must not leave a CREATED (unsealed) object behind:
+        # the next attempt's create would report "already exists" (mapped
+        # to "ok") while readers spin on an object nobody is filling.
+        try:
+            self.store.delete(key)
+        except Exception:
+            pass
 
     def _drop_location(self, key: bytes, holder_id: Optional[bytes]) -> None:
         if holder_id is None:

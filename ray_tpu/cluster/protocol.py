@@ -6,7 +6,7 @@ never goes through it: large objects move via the shm store and node-to-node
 chunk streaming in node_daemon.py, and dense math moves over ICI via XLA
 collectives).
 
-Wire format: [4B little-endian length][pickle(frame)] both ways. Two frame
+Wire format: [4B little-endian length][payload] both ways. Two frame
 shapes coexist on the request side:
 
 - classic: ``(method, kwargs)`` — one in-flight request per connection,
@@ -18,6 +18,19 @@ shapes coexist on the request side:
 
 ``__batch__`` is a virtual method multiplexing N calls into one frame
 (parity: the reference's batched GCS RPCs); it rides either frame shape.
+
+Payload encoding: plain pickle (protocol 5, first byte 0x80), OR — when the
+frame carries large binary data (object chunks: fetch_chunk replies,
+push_chunk requests) — an out-of-band form (first byte 0x01) where every
+``pickle.PickleBuffer`` ≥ _OOB_MIN_BYTES stays a separate segment:
+
+    [0x01][u32 nbuf][u64 len]*nbuf [u32 pickle_len][pickle][buf 0][buf 1]...
+
+The sender never copies those buffers into the pickle stream (they go
+straight from the source mapping to ``sendmsg``), and the receiver hands
+them out as zero-copy memoryviews over the received frame — the data-plane
+analog of the reference shipping chunk payloads as raw gRPC bytes rather
+than re-serializing them (object_manager.h chunk transfer).
 """
 
 from __future__ import annotations
@@ -51,17 +64,97 @@ def _send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(struct.pack("<I", len(payload)) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+# Buffers at or above this size are shipped out-of-band (never copied into
+# the pickle stream). Below it the copy is cheaper than the extra iovec.
+_OOB_MIN_BYTES = 256 * 1024
+
+
+def _dumps_parts(obj: Any) -> List[Any]:
+    """Serialize to a list of buffer segments for scatter-send.
+
+    Large ``pickle.PickleBuffer`` values inside ``obj`` stay zero-copy: the
+    pickle stream only records a placeholder and the raw buffer rides the
+    wire as its own segment (see the module docstring for the layout)."""
+    bufs: List[memoryview] = []
+
+    def _cb(pb: pickle.PickleBuffer) -> bool:
+        # Truthy return = serialize in-band; falsy = keep out-of-band.
+        try:
+            view = pb.raw()
+        except BufferError:
+            return True  # non-contiguous: fall back in-band
+        if view.nbytes < _OOB_MIN_BYTES:
+            return True
+        bufs.append(view)
+        return False
+
+    pkl = pickle.dumps(obj, protocol=5, buffer_callback=_cb)
+    if not bufs:
+        return [pkl]
+    header = struct.pack("<BI", 1, len(bufs)) \
+        + b"".join(struct.pack("<Q", v.nbytes) for v in bufs) \
+        + struct.pack("<I", len(pkl))
+    return [header, pkl, *bufs]
+
+
+def _loads_frame(payload: Any) -> Any:
+    """Inverse of _dumps_parts over one received frame payload.
+
+    Out-of-band buffers come back as memoryviews over the receive buffer —
+    no per-chunk copy between socket and consumer."""
+    if not payload or payload[0] != 1:
+        return pickle.loads(payload)
+    mv = memoryview(payload)
+    (nbuf,) = struct.unpack_from("<I", mv, 1)
+    off = 5
+    lens = struct.unpack_from("<%dQ" % nbuf, mv, off)
+    off += 8 * nbuf
+    (pklen,) = struct.unpack_from("<I", mv, off)
+    off += 4
+    pkl = mv[off:off + pklen]
+    off += pklen
+    bufs = []
+    for n in lens:
+        bufs.append(mv[off:off + n])
+        off += n
+    return pickle.loads(pkl, buffers=bufs)
+
+
+def _send_parts(sock: socket.socket, parts: List[Any]) -> None:
+    """Scatter-send [length][part0][part1]... without concatenating: one
+    sendmsg per iovec batch straight from the source buffers (for chunk
+    transfers that means directly out of the pinned shm mapping)."""
+    if len(parts) == 1:
+        # Plain frame (no out-of-band buffers) — the common control-plane
+        # case: one small concat + sendall beats iovec bookkeeping.
+        payload = parts[0]
+        sock.sendall(struct.pack("<I", len(payload)) + payload)
+        return
+    views = [memoryview(p).cast("B") for p in parts]
+    total = sum(v.nbytes for v in views)
+    views.insert(0, memoryview(struct.pack("<I", total)))
+    while views:
+        sent = sock.sendmsg(views)
+        while views and sent >= views[0].nbytes:
+            sent -= views[0].nbytes
+            views.pop(0)
+        if views and sent:
+            views[0] = views[0][sent:]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionLost("connection closed")
-        buf += chunk
-    return bytes(buf)
+        got += r
+    return buf
 
 
-def _recv_frame(sock: socket.socket) -> bytes:
+def _recv_frame(sock: socket.socket) -> bytearray:
     (length,) = struct.unpack("<I", _recv_exact(sock, 4))
     return _recv_exact(sock, length)
 
@@ -87,15 +180,15 @@ def _dispatch(service: Any, method: str, kwargs: dict) -> Tuple[bool, Any]:
         return False, e
 
 
-def _safe_dumps(resp: tuple) -> bytes:
+def _safe_dumps(resp: tuple) -> List[Any]:
     try:
-        return pickle.dumps(resp, protocol=5)
+        return _dumps_parts(resp)
     except Exception:
         # Replace the unpicklable payload, keep the frame shape (a seq
         # prefix must survive so pipelined callers still match it).
         err = RpcError("unpicklable response")
         fallback = resp[:-2] + (False, err)
-        return pickle.dumps(fallback, protocol=5)
+        return [pickle.dumps(fallback, protocol=5)]
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -110,9 +203,9 @@ class _Handler(socketserver.BaseRequestHandler):
             self._pool.shutdown(wait=False)
 
     def _respond(self, resp: tuple) -> None:
-        payload = _safe_dumps(resp)
+        parts = _safe_dumps(resp)
         with self._send_lock:
-            _send_frame(self.request, payload)
+            _send_parts(self.request, parts)
 
     def _sever(self) -> None:
         try:
@@ -152,7 +245,7 @@ class _Handler(socketserver.BaseRequestHandler):
             except (ConnectionLost, OSError):
                 return
             try:
-                frame = pickle.loads(req)
+                frame = _loads_frame(req)
                 if len(frame) == 3:
                     seq, method, kwargs = frame
                 else:
@@ -263,9 +356,9 @@ class _PipeChannel:
             if fault_plane.fire("rpc.client.send", method=method,
                                 pipelined=True) == "sever":
                 self._sock.close()
-            frame = pickle.dumps((seq, method, kwargs), protocol=5)
+            parts = _dumps_parts((seq, method, kwargs))
             with self._send_lock:
-                _send_frame(self._sock, frame)
+                _send_parts(self._sock, parts)
         except BaseException as e:  # noqa: BLE001
             with self._lock:
                 self._pending.pop(seq, None)
@@ -277,7 +370,7 @@ class _PipeChannel:
     def _read_loop(self) -> None:
         while True:
             try:
-                seq, ok, payload = pickle.loads(_recv_frame(self._sock))
+                seq, ok, payload = _loads_frame(_recv_frame(self._sock))
             except BaseException as e:  # noqa: BLE001 - socket died
                 self._fail_all(e)
                 return
@@ -400,10 +493,10 @@ class RpcClient:
                 sock.settimeout(_timeout)
             if fault_plane.fire("rpc.client.send", method=method) == "sever":
                 sock.close()
-            _send_frame(sock, pickle.dumps((method, kwargs), protocol=5))
+            _send_parts(sock, _dumps_parts((method, kwargs)))
             if fault_plane.fire("rpc.client.recv", method=method) == "sever":
                 sock.close()  # request sent, reply lost: the unacked window
-            ok, payload = pickle.loads(_recv_frame(sock))
+            ok, payload = _loads_frame(_recv_frame(sock))
             if _timeout is not None:
                 sock.settimeout(self._timeout)
         except BaseException as e:
@@ -433,6 +526,19 @@ class RpcClient:
             if self._pipe is None or self._pipe.dead is not None:
                 self._pipe = _PipeChannel(self._connect())
             return self._pipe
+
+    def sever_pipe(self) -> None:
+        """Kill the pipelined channel's socket mid-flight (the honor hook
+        for data-plane "sever" fault actions: object.pull.window,
+        object.push.chunk). Every pending call_async future on the channel
+        fails fast with ConnectionLost via _fail_all."""
+        with self._pipe_lock:
+            pipe = self._pipe
+        if pipe is not None:
+            try:
+                pipe._sock.close()
+            except OSError:
+                pass
 
     def call_async(self, method: str, _retry: bool = False,
                    **kwargs) -> Future:

@@ -15,11 +15,14 @@ chunk window role).
 
 from __future__ import annotations
 
+import pickle
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Tuple
 
+from ray_tpu.cluster import fault_plane
 from ray_tpu.cluster.protocol import get_client
 
 PUSH_CHUNK = 1 << 20          # bytes per push_chunk RPC
@@ -73,6 +76,7 @@ class PushManager:
                 with self._lock:
                     self._bytes += size
                 admitted = size
+                from ray_tpu import config
                 cli = get_client(target)
                 # Per-push stream id: lets the receiver tell this push's
                 # chunks apart from a competing sender's (node_daemon
@@ -80,18 +84,39 @@ class PushManager:
                 # destroying the in-progress entry).
                 import os as _os
                 stream = _os.urandom(8).hex()
-                off = 0
-                while off < size:
-                    n = min(PUSH_CHUNK, size - off)
+                # Windowed pipelined sends (push_manager.h chunk window):
+                # keep object_push_window chunk RPCs in flight on one
+                # channel; the receiver accepts out-of-order offsets within
+                # a stream. PickleBuffer chunks ride the RPC frame's
+                # out-of-band path — sent straight from the shm mapping,
+                # never copied into a bytes().
+                window = max(1, int(config.get("object_push_window")))
+                futs: deque = deque()
+
+                def _acked_terminal() -> bool:
                     # Bounded per-chunk wait: a hung destination must not
                     # pin this pool thread / the in-flight byte budget.
-                    resp = cli.call("push_chunk", oid=key, offset=off,
-                                    total=size,
-                                    chunk=bytes(view[off:off + n]),
-                                    stream=stream, _timeout=30.0)
-                    if resp.get("done") or resp.get("reject"):
-                        return  # destination has it / is pulling it already
+                    resp = futs.popleft().result(timeout=30.0)
+                    # done/reject: destination has it / is pulling it.
+                    return bool(resp.get("done") or resp.get("reject"))
+
+                done = False
+                off = 0
+                while off < size and not done:
+                    n = min(PUSH_CHUNK, size - off)
+                    act = fault_plane.fire("object.push.chunk", oid=key,
+                                           offset=off, target=target)
+                    if act == "sever":
+                        cli.sever_pipe()
+                    futs.append(cli.call_async(
+                        "push_chunk", oid=key, offset=off, total=size,
+                        chunk=pickle.PickleBuffer(view[off:off + n]),
+                        stream=stream))
                     off += n
+                    while len(futs) >= window and not done:
+                        done = _acked_terminal()
+                while futs and not done:
+                    done = _acked_terminal()
             finally:
                 self.store.release(key)
         except Exception:

@@ -133,6 +133,30 @@ define("memory_monitor_refresh_ms", int, 250,
 define("max_concurrent_pull_bytes", int, 256 * 1024 * 1024,
        "Byte budget for concurrent remote-object pulls per process "
        "(pull_manager.h:52 admission control role).")
+define("object_pull_window", int, 4,
+       "Chunks kept in flight per pull: the puller pipelines this many "
+       "fetch_chunk RPCs on one channel and writes completions into the "
+       "store out of order, so transfer bandwidth is not round-trip-bound "
+       "(parity: object_manager max_chunks_in_flight).")
+define("object_push_window", int, 4,
+       "Chunks kept in flight per push (push_manager.h chunk window role); "
+       "the receiver accepts out-of-order chunk offsets within a stream.")
+define("object_stripe_min_bytes", int, 16 * 1024 * 1024,
+       "Pulls of objects at least this large stripe their chunk ranges "
+       "across multiple advertised holders; smaller transfers use one "
+       "least-loaded holder (the striping setup costs a probe per holder).")
+define("object_pull_max_sources", int, 4,
+       "Max holders one striped pull reads from concurrently.")
+define("object_transfer_chunk_bytes", int, 8 * 1024 * 1024,
+       "Pull-side chunk size for node-to-node object transfer (parity: "
+       "object_manager_default_chunk_size). Tests shrink it to exercise "
+       "many-chunk windows on small objects.")
+define("object_pull_shm_direct", bool, True,
+       "When a holder's segment file is visible on this host's /dev/shm "
+       "(daemons sharing a machine), pull by pinning the remote segment "
+       "and copying mapping-to-mapping instead of streaming chunks over "
+       "TCP (parity: plasma same-node zero-copy sharing). Tests that "
+       "exercise the chunked TCP path disable this.")
 define("lease_reuse_enabled", bool, True,
        "Reuse a granted worker lease for queued tasks with the same scheduling "
        "key (the reference's lease-reuse fast path, direct_task_transport.cc).")

@@ -1112,9 +1112,25 @@ class ClusterRuntime:
         """Event-driven wait: one conductor long-poll parks on the object
         directory CV until ``num_returns`` of the refs exist (put/seal paths
         register locations synchronously, so the directory is authoritative;
-        round 2 polled per-ref store contains() at 5ms — judge weak #3)."""
+        round 2 polled per-ref store contains() at 5ms — judge weak #3).
+
+        Local fast path first: ONE batched store round trip resolves every
+        ref already sealed on this node — location registration is batched
+        (eventual), so freshly put/returned objects can satisfy the wait
+        before the directory hears about them, and a wait over 1k local
+        refs never pays the conductor RPC at all."""
         deadline = None if timeout is None else time.monotonic() + timeout
         keys = [self.plane._key(r.id) for r in refs]
+        local = self.plane.contains_batch([r.id for r in refs])
+        if sum(local) >= num_returns:
+            ready_l: List[ObjectRef] = []
+            pending_l: List[ObjectRef] = []
+            for r, e in zip(refs, local):
+                if e and len(ready_l) < num_returns:
+                    ready_l.append(r)
+                else:
+                    pending_l.append(r)
+            return ready_l, pending_l
         while True:
             remaining = None if deadline is None \
                 else max(0.0, deadline - time.monotonic())
@@ -1124,7 +1140,7 @@ class ClusterRuntime:
                     "wait_objects", oids=keys, num_needed=num_returns,
                     timeout=step, _timeout=step + 10.0)
             except Exception:
-                exist = [self.plane.contains(r.id) for r in refs]
+                exist = self.plane.contains_batch([r.id for r in refs])
                 time.sleep(0.05)
             ready: List[ObjectRef] = []
             pending: List[ObjectRef] = []

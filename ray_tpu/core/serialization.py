@@ -130,8 +130,9 @@ def serialize_segments(value: Any) -> Tuple[int, List, List[ObjectRef]]:
 def serialize(value: Any) -> Tuple[bytes, List[ObjectRef]]:
     """Serialize ``value``; returns (blob, contained ObjectRefs)."""
     total, segments, refs = serialize_segments(value)
-    return b"".join(bytes(s) if not isinstance(s, bytes) else s
-                    for s in segments), refs
+    # join() accepts the memoryview segments directly (they are contiguous
+    # "B" views by construction) — ONE copy into the blob, not two.
+    return b"".join(segments), refs
 
 
 def serialized_size(blob: bytes) -> int:

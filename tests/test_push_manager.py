@@ -104,6 +104,32 @@ def test_push_chunk_rejects_existing(cluster):
     assert rt.get(ref) == b"already-here"
 
 
+def test_push_chunk_out_of_order_and_duplicate(cluster):
+    """Windowed senders pipeline chunks on one channel, so the receiver
+    must accept ANY arrival order within a stream (the tail chunk may
+    create the entry) and ack duplicate offsets idempotently (the RPC
+    layer is at-least-once)."""
+    runtime = core_api._runtime
+    cli = get_client(runtime.daemon_address)
+    oid = b"push-ooo--" + b"\x02" * 6  # 16-byte store key
+    total = 8
+    r1 = cli.call("push_chunk", oid=oid, offset=4, total=total,
+                  chunk=b"WXYZ", stream="s-ooo")   # tail arrives first
+    assert r1.get("ok")
+    rdup = cli.call("push_chunk", oid=oid, offset=4, total=total,
+                    chunk=b"WXYZ", stream="s-ooo")
+    assert rdup.get("ok")          # duplicate: acked, not double-counted
+    r2 = cli.call("push_chunk", oid=oid, offset=0, total=total,
+                  chunk=b"ABCD", stream="s-ooo")
+    assert r2.get("done")          # byte-count completion despite the dup
+    view = runtime.plane.store.get(oid, timeout=5.0)
+    assert view is not None
+    try:
+        assert bytes(view) == b"ABCDWXYZ"
+    finally:
+        runtime.plane.store.release(oid)
+
+
 def test_push_chunk_competing_stream_rejected(cluster):
     """A second sender's offset-0 chunk must NOT destroy the first sender's
     in-progress push: the intruder is rejected, the original stream keeps
