@@ -43,6 +43,44 @@ def settle(seconds: float = 1.0) -> None:
     time.sleep(seconds)
 
 
+def compare_results(old: dict, new: dict, tolerance: float) -> list:
+    """Regression gate over two result dicts (or whole output files —
+    either shape is accepted). Compares every metric PRESENT IN BOTH whose
+    name marks it rate-like (``*_per_sec`` / ``*_gb_per_sec`` — higher is
+    better); metrics only one side has are skipped, so the gate survives
+    suite growth. Returns the list of (name, old, new, ratio) regressions
+    where ``new < tolerance * old``."""
+    old = old.get("results", old)
+    new = new.get("results", new)
+    bad = []
+    for name in sorted(set(old) & set(new)):
+        if not (name.endswith("_per_sec") or name.endswith("_gb_per_sec")):
+            continue
+        o, n = old[name], new[name]
+        if not o:
+            continue  # zero/absent baseline: no meaningful ratio
+        ratio = n / o
+        status = "ok" if n >= tolerance * o else "REGRESSED"
+        print(f"  {name:45s} {o:>12} -> {n:>12}  x{ratio:.2f}  {status}")
+        if status == "REGRESSED":
+            bad.append((name, o, n, ratio))
+    return bad
+
+
+def run_compare(old_path: str, new_path: str, tolerance: float) -> int:
+    with open(old_path) as f:
+        old = json.load(f)
+    with open(new_path) as f:
+        new = json.load(f)
+    print(f"compare: {old_path} -> {new_path} (tolerance {tolerance})")
+    bad = compare_results(old, new, tolerance)
+    if bad:
+        print(f"{len(bad)} metric(s) below {tolerance}x of baseline")
+        return 1
+    print("no regressions")
+    return 0
+
+
 def main(argv=None) -> int:
     # CPU default only for the benchmark run itself (library importers of
     # this module must NOT have their jax platform silently forced).
@@ -50,7 +88,17 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", type=int, default=0)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--compare", nargs=2, metavar=("OLD.json", "NEW.json"),
+                    help="regression gate: compare two result files and "
+                    "exit nonzero if any shared rate metric fell below "
+                    "--tolerance x the old value (no benchmarks are run)")
+    ap.add_argument("--tolerance", type=float, default=0.8,
+                    help="--compare pass threshold as a fraction of the "
+                    "old value (default 0.8; benchmarks on shared hosts "
+                    "need slack for scheduler noise)")
     args = ap.parse_args(argv)
+    if args.compare:
+        return run_compare(args.compare[0], args.compare[1], args.tolerance)
 
     import numpy as np
 
@@ -155,6 +203,22 @@ def main(argv=None) -> int:
         per, _ = timed(task_roundtrip, min_time=2.0 * scale)
         results["task_roundtrip_per_sec"] = round(1 / per, 1)
 
+        # -- inline-return roundtrip (reply-carried 1KiB payload) -----
+        # Exercises the execution-plane fast path end to end: the result
+        # rides the push reply, the caller's get() is served from the
+        # inline cache, and the store seal happens off the critical path.
+        payload = b"p" * 1024
+
+        @ray_tpu.remote
+        def echo(x):
+            return x
+
+        def task_roundtrip_inline():
+            ray_tpu.get(echo.remote(payload))
+
+        per, _ = timed(task_roundtrip_inline, min_time=2.0 * scale)
+        results["task_roundtrip_inline_per_sec"] = round(1 / per, 1)
+
         # -- async task throughput (pipelined submissions) ------------
         n_tasks = int(1000 * scale) or 100
 
@@ -183,6 +247,22 @@ def main(argv=None) -> int:
 
         per, _ = timed(actor_sync, min_time=2.0 * scale)
         results["actor_call_sync_per_sec"] = round(1 / per, 1)
+
+        # -- inline actor call (1KiB reply-carried result) ------------
+        @ray_tpu.remote
+        class Echo:
+            def echo(self, x):
+                return x
+
+        e = Echo.remote()
+        ray_tpu.get(e.echo.remote(b""))
+
+        def actor_call_inline():
+            ray_tpu.get(e.echo.remote(payload))
+
+        per, _ = timed(actor_call_inline, min_time=2.0 * scale)
+        results["actor_call_inline_per_sec"] = round(1 / per, 1)
+        ray_tpu.kill(e)
 
         n_calls = int(1000 * scale) or 100
 

@@ -43,6 +43,14 @@ OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_STATS, \
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_OOM, ST_TIMEOUT, ST_ERR, ST_NOT_SEALED, \
     ST_BUSY = range(8)
 
+
+def _default_inline_max() -> int:
+    """Inline-get size cap = the system-wide small-object threshold
+    (config max_inline_object_bytes); the daemon has no server-side cap —
+    the client's max_bytes alone decides inline vs zero-copy."""
+    from ray_tpu import config
+    return int(config.get("max_inline_object_bytes"))
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "_native")
 SHMSTORED = os.path.join(_NATIVE_DIR, "shmstored")
@@ -440,17 +448,53 @@ class ShmClient:
             raise ObjectStoreError(f"put_inline failed: status {st}")
         return True
 
+    def put_inline_batch(self, items) -> int:
+        """Pipelined small-object puts: every OP_PUT_INLINE frame hits the
+        wire before the first reply is read (the daemon serves one
+        connection's requests serially and in order, so replies match
+        request order). One send/recv burst per batch instead of a store
+        round trip per object — this is the lazy sealer's backstop write
+        load, stolen from the task ping-pong on small hosts.
+
+        ``items``: iterable of (oid16, bytes-like). Per-object failures
+        (exists/OOM) are tolerated — returns the count actually written.
+        """
+        frames = []
+        for oid, data in items:
+            m = memoryview(data)
+            if m.format != "B":
+                m = m.cast("B")
+            payload = struct.pack("<B16s", OP_PUT_INLINE, oid) + bytes(m)
+            frames.append(struct.pack("<I", len(payload)) + payload)
+        if not frames:
+            return 0
+        wrote = 0
+        with self._lock:
+            while self._deferred_releases:
+                oid = self._deferred_releases.popleft()
+                self._sock.sendall(struct.pack("<IB16s", 17, OP_RELEASE, oid))
+                self._read_frame()
+            self._sock.sendall(b"".join(frames))
+            for _ in frames:
+                if self._read_frame()[0] == ST_OK:
+                    wrote += 1
+        return wrote
+
     # Oids per OP_GET_COPY_BATCH round trip: bounds the daemon's reply
-    # buffer (~64MB worst case at the 64KB inline cap) and keeps the reply
-    # length far from u32 framing limits.
+    # buffer (~100MB worst case at the default 100KB inline cap — raise
+    # max_inline_object_bytes past ~4MB and this needs revisiting) and
+    # keeps the reply length far from u32 framing limits.
     _GET_BATCH = 1024
 
     def get_inline_batch(self, oids: List[bytes],
-                         max_bytes: int = 64 << 10
+                         max_bytes: Optional[int] = None
                          ) -> List[Optional[bytes]]:
         """Inline-get MANY objects in few round trips; None per miss
         (absent / unsealed / larger than max_bytes — callers fall back to
-        the zero-copy path for those)."""
+        the zero-copy path for those). max_bytes defaults to the config's
+        max_inline_object_bytes."""
+        if max_bytes is None:
+            max_bytes = _default_inline_max()
         out: List[Optional[bytes]] = []
         for start in range(0, len(oids), self._GET_BATCH):
             chunk = oids[start:start + self._GET_BATCH]
@@ -473,12 +517,15 @@ class ShmClient:
         return out
 
     def get_inline(self, oid: bytes,
-                   max_bytes: int = 64 << 10) -> Optional[bytes]:
+                   max_bytes: Optional[int] = None) -> Optional[bytes]:
         """Small-object fast path (OP_GET_COPY): the sealed payload comes
         back INLINE in one round trip — no refcount, no mmap, no release.
         Returns None when the object is missing, unsealed, or larger than
         max_bytes (callers fall back to the zero-copy get/release path).
+        max_bytes defaults to the config's max_inline_object_bytes.
         """
+        if max_bytes is None:
+            max_bytes = _default_inline_max()
         resp = self._call(struct.pack("<B16sQ", OP_GET_COPY, oid, max_bytes))
         st = resp[0]
         if st != ST_OK:

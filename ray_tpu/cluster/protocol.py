@@ -36,16 +36,36 @@ than re-serializing them (object_manager.h chunk transfer).
 from __future__ import annotations
 
 import itertools
+import os
 import pickle
 import socket
 import socketserver
 import struct
+import tempfile
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.cluster import fault_plane
+
+
+def _uds_path(port: int) -> str:
+    """Filesystem rendezvous for the same-host fast path: every server
+    listening on 127.0.0.1:<port> ALSO listens on this Unix socket, and
+    loopback clients prefer it (a UDS round trip skips the TCP/IP stack —
+    measurably cheaper send syscalls on the task push ping-pong). The path
+    is derived from the port alone so a client needs nothing beyond the
+    ordinary host:port address to find it."""
+    return os.path.join(tempfile.gettempdir(), f"rtpu-rpc-{port}.sock")
+
+
+def _uds_enabled() -> bool:
+    from ray_tpu import config
+    try:
+        return bool(config.get("rpc_same_host_uds"))
+    except Exception:
+        return True
 
 
 class RpcError(Exception):
@@ -237,7 +257,8 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def handle(self):
         sock = self.request
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if sock.family != socket.AF_UNIX:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         service = self.server.service  # type: ignore[attr-defined]
         while True:
             try:
@@ -253,9 +274,18 @@ class _Handler(socketserver.BaseRequestHandler):
             except Exception:
                 return
             if seq is not None:
-                # Pipelined frame: dispatch off-thread so the read loop
-                # keeps draining — a long-poll must not head-of-line-block
-                # the requests queued behind it on this socket.
+                # Pipelined frame: normally dispatched off-thread so the
+                # read loop keeps draining — a long-poll must not
+                # head-of-line-block the requests queued behind it on this
+                # socket. Services whose pipelined callers are strictly
+                # request-at-a-time per channel (the worker: one in-flight
+                # push per lease / per-actor ordered pushers) opt into
+                # INLINE dispatch via ``rpc_inline_pipelined`` and skip
+                # the executor handoff — a thread wake per push on the
+                # task round-trip critical path.
+                if getattr(service, "rpc_inline_pipelined", False):
+                    self._run_pipelined(service, seq, method, kwargs)
+                    continue
                 if self._pool is None:
                     self._pool = ThreadPoolExecutor(
                         max_workers=16, thread_name_prefix="rpc-pipe")
@@ -286,11 +316,26 @@ class _Server(socketserver.ThreadingTCPServer):
         super().__init__(*args, **kwargs)
 
 
+class _UnixServer(socketserver.ThreadingUnixStreamServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        self._conns: set = set()
+        super().__init__(*args, **kwargs)
+
+
 class RpcServer:
     """Serves ``rpc_*`` methods of a service object on host:port.
 
     Handlers run on a thread per connection; blocking inside a handler (e.g.
     a long-poll wait on a condition variable) only stalls that client.
+
+    Alongside the TCP listener, the server binds a Unix socket at
+    ``_uds_path(port)`` (same handler, same service): loopback clients
+    connect there instead of through the TCP/IP stack. Failover-safe by
+    the same port-takeover convention as TCP — a successor binding the
+    port unlinks and re-binds the path.
     """
 
     def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0):
@@ -302,6 +347,23 @@ class RpcServer:
             target=self._srv.serve_forever, daemon=True,
             name=f"rpc-{type(service).__name__}")
         self._thread.start()
+        self._usrv: Optional[_UnixServer] = None
+        self._upath: Optional[str] = None
+        if _uds_enabled():
+            try:
+                path = _uds_path(self.port)
+                try:
+                    os.unlink(path)   # stale socket from a dead predecessor
+                except FileNotFoundError:
+                    pass
+                self._usrv = _UnixServer(path, _Handler)
+                self._usrv.service = service  # type: ignore[attr-defined]
+                self._upath = path
+                threading.Thread(
+                    target=self._usrv.serve_forever, daemon=True,
+                    name=f"rpc-uds-{type(service).__name__}").start()
+            except OSError:
+                self._usrv = None   # TCP alone still serves everything
 
     def stop(self) -> None:
         try:
@@ -309,11 +371,25 @@ class RpcServer:
             self._srv.server_close()
         except OSError:
             pass
+        if self._usrv is not None:
+            try:
+                self._usrv.shutdown()
+                self._usrv.server_close()
+            except OSError:
+                pass
+            try:
+                if self._upath:
+                    os.unlink(self._upath)
+            except OSError:
+                pass
         # Sever live connections too: a handler thread parked on recv would
         # otherwise keep serving this (dead) service's stale in-memory
         # state to clients holding pooled sockets — fatal for failover,
         # where a successor binds the same port.
-        for sock in list(self._srv._conns):
+        conns = list(self._srv._conns)
+        if self._usrv is not None:
+            conns += list(self._usrv._conns)
+        for sock in conns:
             try:
                 sock.shutdown(socket.SHUT_RDWR)
             except OSError:
@@ -432,6 +508,23 @@ class RpcClient:
         self._pipe_lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
+        host = self._target[0]
+        if host in ("127.0.0.1", "localhost") and _uds_enabled():
+            # Same-host fast path: the server mirrors its TCP listener on a
+            # Unix socket. Any failure (no file, refused, server predates
+            # the feature) falls straight back to TCP.
+            path = _uds_path(self._target[1])
+            if os.path.exists(path):
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    s.settimeout(self._timeout)
+                    s.connect(path)
+                    return s
+                except OSError:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
         sock = socket.create_connection(self._target, timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock

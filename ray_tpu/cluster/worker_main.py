@@ -21,14 +21,80 @@ import inspect
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 from ray_tpu.cluster import fault_plane, object_client
 from ray_tpu.cluster.object_plane import ObjectPlane
 from ray_tpu.cluster.protocol import RpcServer, get_client
-from ray_tpu.core import serialization
-from ray_tpu.core.exceptions import TaskError
-from ray_tpu.core.ids import ObjectID, TaskID, WorkerID
+from ray_tpu.core import serialization, task_spec
+from ray_tpu.core import refs as _refs_mod
+from ray_tpu.core.exceptions import (GetTimeoutError, ObjectLostError,
+                                     TaskError)
+from ray_tpu.core.ids import ObjectID, TaskID, WorkerID, store_key
+
+
+class _LazySealer:
+    """Deferred store seal of reply-carried (inline) returns.
+
+    The push reply carries the serialized result; the caller is already
+    unblocked, so the store write is pure backstop work — it is what makes
+    the object visible to remote pulls, wait(), and lineage reconstruction
+    (the reference keeps small direct-call returns owner-memory-only; we
+    diverge by sealing lazily so the rest of the object plane needs no
+    special inline-object protocol). Runs on one background thread; a
+    short defer lets the ack win the race to the wire and lets a burst of
+    task results coalesce."""
+
+    _DEFER_S = 0.001
+
+    def __init__(self, plane: ObjectPlane):
+        self.plane = plane
+        self._q = deque()
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lazy-seal")
+        self._thread.start()
+
+    def enqueue(self, jobs) -> None:
+        """jobs: iterable of (ObjectID, serialized blob)."""
+        with self._cv:
+            self._q.extend(jobs)
+            self._cv.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q:
+                    self._cv.wait()
+                jobs = list(self._q)
+                self._q.clear()
+            time.sleep(self._DEFER_S)
+            batch = []
+            for oid, blob in jobs:
+                try:
+                    # Fault point: the reply->seal gap. A "crash" rule here
+                    # kills the worker AFTER the caller cached the value
+                    # but BEFORE any store copy exists — the window where
+                    # remote consumers must get a lost verdict (probe miss
+                    # on the pre-registered location) and recover via
+                    # lineage instead of hanging.
+                    fault_plane.fire("task.return.seal", oid=oid.hex())
+                    batch.append((oid, blob))
+                except Exception:
+                    pass  # fault rule raised: skip this seal
+            try:
+                # One pipelined store burst for the coalesced backlog
+                # (every blob here is reply-sized, i.e. <= the inline cap).
+                self.plane.put_blobs_inline(batch)
+            except Exception:
+                # Store gone (shutdown) or a mid-batch error: fall back to
+                # per-object puts so one bad blob can't strand the rest.
+                for oid, blob in batch:
+                    try:
+                        self.plane.put_blob(oid, blob)
+                    except Exception:
+                        pass
 
 
 class TaskEventLog:
@@ -70,6 +136,18 @@ class TaskEventLog:
 class WorkerService:
     """The worker's RPC surface (tasks pushed directly by submitters)."""
 
+    # Pipelined frames dispatch INLINE on the channel's reader thread
+    # (protocol._Handler.handle) instead of through the per-connection
+    # executor. Safe here — and only here — because every pipelined
+    # caller of this service is strictly request-at-a-time per channel:
+    # the task submitter keeps one in-flight push per leased worker, and
+    # actor pushers serialize on seqno. Control frames that must never
+    # queue behind a running task (ping, cancel_task, kill_actor) arrive
+    # classic on separate connections. Conductor/daemon services must NOT
+    # set this: their channels carry long-polls that would head-of-line
+    # block everything behind them.
+    rpc_inline_pipelined = True
+
     def __init__(self, conductor_address: str, daemon_address: str,
                  store_socket: str, store_prefix: str, node_id: bytes):
         self.worker_id = WorkerID.from_random()
@@ -78,6 +156,11 @@ class WorkerService:
         self.node_id = node_id
         self.store = object_client.ShmClient(store_socket, store_prefix)
         self.plane = ObjectPlane(self.store, node_id, conductor_address)
+        self._sealer = _LazySealer(self.plane)
+        self._ilim_gen = None       # inline-return limit, config-cached
+        self._ilim_v = -1
+        self._ftmo_gen = None       # arg-fetch timeout, config-cached
+        self._ftmo_v = 30.0
         self.events = TaskEventLog(conductor_address, node_id, os.getpid())
         self._fn_cache: Dict[str, Any] = {}
         self._exec_lock = threading.Lock()   # serial normal-task execution
@@ -134,28 +217,41 @@ class WorkerService:
             self._fn_cache[function_id] = fn
         return fn
 
-    def _resolve(self, args_blob: bytes):
-        from ray_tpu import config
-        from ray_tpu.core.exceptions import ObjectLostError
-        from ray_tpu.core.exceptions import GetTimeoutError
-        from ray_tpu.core.refs import ObjectRef
-        args, kwargs = serialization.loads(args_blob)
+    def _fetch_timeout(self) -> float:
         # Bounded fetch: a dependency that was GC-freed or lost without
         # lineage must fail the task (visible to the caller) rather than
-        # hang this worker forever.
-        timeout = config.get("worker_fetch_timeout_s")
+        # hang this worker forever. Cached against the config generation
+        # (config.get walks os.environ; this sits on every task).
+        from ray_tpu import config
+        if self._ftmo_gen != config.generation:
+            self._ftmo_v = config.get("worker_fetch_timeout_s")
+            self._ftmo_gen = config.generation
+        return self._ftmo_v
 
-        def rv(v):
-            if not isinstance(v, ObjectRef):
-                return v
+    def _resolve(self, args_blob: bytes,
+                 inline_args: Optional[dict] = None):
+        args, kwargs = serialization.loads(args_blob)
+        if not args and not kwargs:
+            return args, kwargs
+        timeout = self._fetch_timeout()
+
+        def rv(ref):
+            if inline_args:
+                # In-spec small arg (submit-side inliner): the serialized
+                # value rode the task spec — no store fetch, no pin.
+                blob = inline_args.get(store_key(ref.id.binary()))
+                if blob is not None:
+                    return serialization.deserialize(memoryview(blob))
             try:
-                return self.plane.get_value(v.id, timeout=timeout)
+                return self.plane.get_value(ref.id, timeout=timeout)
             except GetTimeoutError:
                 raise ObjectLostError(
-                    v.id.hex(), f"task argument unavailable after "
+                    ref.id.hex(), f"task argument unavailable after "
                     f"{timeout}s (freed or lost)") from None
 
-        return [rv(a) for a in args], {k: rv(v) for k, v in kwargs.items()}
+        # Shared rule with the submit side (task_spec.top_level_ref_args):
+        # only TOP-LEVEL ref args resolve by value.
+        return task_spec.resolve_task_args(args, kwargs, rv)
 
     def _flush_refs(self) -> None:
         """Ship this process's pending refcount events to the conductor
@@ -163,33 +259,99 @@ class WorkerService:
         argument pins on the ack, so any +1 this execution produced (user
         code keeping a borrowed ref) must be in the ledger first
         (core/refcount.py ordering protocol)."""
-        from ray_tpu.core import refs as _refs_mod
         t = _refs_mod._tracker
         if t is not None:
             t.flush()
 
-    def _store_returns(self, task_id: bytes, num_returns: int, result: Any):
+    def _inline_limit(self) -> int:
+        """Reply-carried return size cap (-1 = feature off); cached against
+        the config generation (this sits on every task return)."""
+        from ray_tpu import config
+        if self._ilim_gen != config.generation:
+            self._ilim_v = (int(config.get("max_inline_object_bytes"))
+                            if config.get("task_inline_returns") else -1)
+            self._ilim_gen = config.generation
+        return self._ilim_v
+
+    def _emit_return(self, oid: ObjectID, value: Any, collect) -> None:
+        """Store one return value. With ``collect`` (reply-carried mode),
+        results at or below max_inline_object_bytes ride the push reply as
+        {"data": blob} entries and seal into the store lazily; larger ones
+        seal now and reply {"stored": True}. collect=None keeps the
+        classic store-now behavior (async/pool actor paths, whose acks
+        predate execution)."""
+        if collect is None:
+            self.plane.put_value(oid, value)
+            return
+        limit = self._inline_limit()
+        total, segments, refs = serialization.serialize_segments(value)
+        if limit < 0 or total > limit:
+            self.plane.put_segments(oid, total, segments, refs)
+            collect.append({"stored": True})
+            return
+        blob = segments[0] if len(segments) == 1 else b"".join(segments)
+        if refs:
+            t = _refs_mod._tracker
+            if t is not None:
+                # flush=False: _flush_refs() runs before the ack AND before
+                # the seal enqueue, so the children's +1s are durable
+                # before the parent becomes readable anywhere — the same
+                # invariant add_children's default sync flush upholds,
+                # batched into one pre-ack RPC instead of one per return.
+                t.add_children(self.plane._key(oid),
+                               [store_key(r.id.binary()) for r in refs],
+                               flush=False)
+        # Fault point: the inlining decision (a "raise" rule fails the
+        # task through the normal error path; see also task.return.seal).
+        fault_plane.fire("task.reply.inline", oid=oid.hex())
+        collect.append({"data": blob, "_oid": oid})
+
+    def _store_returns(self, task_id: bytes, num_returns: int, result: Any,
+                       collect=None):
         tid = TaskID(task_id)
         if num_returns == 1:
-            self.plane.put_value(tid.object_id_for_return(0), result)
+            self._emit_return(tid.object_id_for_return(0), result, collect)
             return
         vals = list(result)
         if len(vals) != num_returns:
             err = TaskError.from_exception(ValueError(
                 f"Task declared num_returns={num_returns} but returned "
                 f"{len(vals)} values"))
+            if collect is not None:
+                collect[:] = []
             for i in range(num_returns):
-                self.plane.put_value(tid.object_id_for_return(i), err)
+                self._emit_return(tid.object_id_for_return(i), err, collect)
             return
         for i, v in enumerate(vals):
-            self.plane.put_value(tid.object_id_for_return(i), v)
+            self._emit_return(tid.object_id_for_return(i), v, collect)
 
-    def _fail_returns(self, task_id: bytes, num_returns: int, exc, desc: str):
+    def _fail_returns(self, task_id: bytes, num_returns: int, exc, desc: str,
+                      collect=None):
         err = exc if isinstance(exc, TaskError) else TaskError.from_exception(
             exc, desc)
         tid = TaskID(task_id)
         for i in range(num_returns):
-            self.plane.put_value(tid.object_id_for_return(i), err)
+            try:
+                self._emit_return(tid.object_id_for_return(i), err, collect)
+            except BaseException:
+                # The error object itself failed to serialize/store: fall
+                # back to a bare TaskError so the caller still unblocks.
+                self._emit_return(tid.object_id_for_return(i),
+                                  TaskError(repr(err), desc), collect)
+
+    def _queue_seals(self, per_task_entries) -> None:
+        """Strip the private _oid markers from reply entries and hand the
+        (oid, blob) pairs to the lazy sealer. Called AFTER _flush_refs():
+        a remotely-readable (sealed) parent must never precede its
+        children's durable +1s."""
+        seals = []
+        for entries in per_task_entries:
+            for e in entries:
+                oid = e.pop("_oid", None)
+                if oid is not None:
+                    seals.append((oid, e["data"]))
+        if seals:
+            self._sealer.enqueue(seals)
 
     # ------------------------------------------------------------------
     # normal tasks
@@ -197,15 +359,19 @@ class WorkerService:
     def _exec_one(self, task_id: bytes, function_id: str,
                   function_blob: Optional[bytes], args_blob: bytes,
                   num_returns: int, name: str,
-                  trace_ctx: Optional[dict] = None) -> None:
-        """Execute one task body; returns are stored before this returns.
-        Caller holds _exec_lock (serial normal-task execution)."""
+                  trace_ctx: Optional[dict] = None,
+                  inline_args: Optional[dict] = None,
+                  collect=None) -> None:
+        """Execute one task body; returns are stored (or collected into the
+        push reply) before this returns. Caller holds _exec_lock (serial
+        normal-task execution)."""
         start = time.time()
         if task_id in self._cancelled:
             self._cancelled.discard(task_id)
             from ray_tpu.core.exceptions import TaskCancelledError
             self._fail_returns(task_id, num_returns,
-                               TaskCancelledError("task cancelled"), name)
+                               TaskCancelledError("task cancelled"), name,
+                               collect)
             return
         error = ""
         try:
@@ -214,12 +380,20 @@ class WorkerService:
             # lineage reconstruction (or task retries) can save the caller.
             fault_plane.fire("worker.task.exec", name=name)
             fn = self._load_fn(function_id, function_blob)
-            args, kwargs = self._resolve(args_blob)
+            args, kwargs = self._resolve(args_blob, inline_args)
             result = fn(*args, **kwargs)
-            self._store_returns(task_id, num_returns, result)
+            self._store_returns(task_id, num_returns, result, collect)
         except BaseException as e:  # noqa: BLE001 - delivered via refs
             error = repr(e)
-            self._fail_returns(task_id, num_returns, e, name)
+            # A partially-collected reply must not misalign the entry list
+            # (one entry per return, in order).
+            if collect is not None:
+                collect[:] = []
+            try:
+                self._fail_returns(task_id, num_returns, e, name, collect)
+            except BaseException:  # noqa: BLE001 - injected double fault
+                if collect is not None:
+                    collect[:] = []
         end = time.time()
         self.events.record(task_id, name, "task", start, end, error)
         if trace_ctx is not None:
@@ -241,21 +415,31 @@ class WorkerService:
 
     def rpc_push_task_batch(self, tasks: list) -> dict:
         """Execute a coalesced batch serially; one ack for all (the
-        submitter batches deep queues — core/runtime_cluster.py _pump)."""
+        submitter batches deep queues — core/runtime_cluster.py _pump).
+        The reply carries each task's small returns inline ({"data": blob}
+        per return, in return order) — the caller seeds its object plane
+        from them and never touches the store; the worker seals the same
+        blobs lazily (_LazySealer) so the objects stay full citizens."""
+        returns: Dict[bytes, list] = {}
         with self._exec_lock:
             for t in tasks:
+                entries: list = []
                 self._exec_one(t["task_id"], t["function_id"],
                                t.get("function_blob"), t["args_blob"],
                                t["num_returns"], t.get("name", ""),
-                               trace_ctx=t.get("trace_ctx"))
+                               trace_ctx=t.get("trace_ctx"),
+                               inline_args=t.get("inline_args"),
+                               collect=entries)
+                returns[t["task_id"]] = entries
         self._flush_refs()
+        self._queue_seals(returns.values())
         if any("trace_ctx" in t for t in tasks):
             from ray_tpu import config
             from ray_tpu.util import tracing
             tracing.flush(get_client(
                 self.conductor_address,
                 reconnect_s=config.get("gcs_rpc_reconnect_s")))
-        return {"ok": True}
+        return {"ok": True, "node_id": self.node_id, "returns": returns}
 
     def rpc_cancel_task(self, task_id: bytes) -> None:
         self._cancelled.add(task_id)
@@ -325,7 +509,8 @@ class WorkerService:
                             seqno: int, method_name: str, args_blob: bytes,
                             num_returns: int,
                             arg_pins: Optional[list] = None,
-                            actor_id: Optional[bytes] = None) -> dict:
+                            actor_id: Optional[bytes] = None,
+                            inline_args: Optional[dict] = None) -> dict:
         """Ordered actor call (per-caller seqno; see class docstring).
         ``actor_id`` guards against a stale address: a recycled worker may
         host a DIFFERENT actor at the address a slow caller cached, and a
@@ -340,7 +525,7 @@ class WorkerService:
         try:
             return self._push_actor_task(task_id, caller_id, seqno,
                                          method_name, args_blob,
-                                         num_returns, arg_pins)
+                                         num_returns, arg_pins, inline_args)
         finally:
             with self._seq_lock:
                 self._active_calls -= 1
@@ -348,7 +533,8 @@ class WorkerService:
     def _push_actor_task(self, task_id: bytes, caller_id: bytes,
                          seqno: int, method_name: str, args_blob: bytes,
                          num_returns: int,
-                         arg_pins: Optional[list] = None) -> dict:
+                         arg_pins: Optional[list] = None,
+                         inline_args: Optional[dict] = None) -> dict:
         name = f"{self.actor_class_name}.{method_name}"
         start = time.time()
         error = ""
@@ -356,7 +542,6 @@ class WorkerService:
         def unpin_args():
             if not arg_pins:
                 return
-            from ray_tpu.core import refs as _refs_mod
             t = _refs_mod._tracker
             if t is not None:
                 t.unpin_all(arg_pins)
@@ -367,7 +552,7 @@ class WorkerService:
                     else:
                         self._taken_pins.pop(k, None)
 
-        def run_sync():
+        def run_sync(collect=None):
             err = ""
             try:
                 # Fault point: kill/fail mid-actor-task — after the seqno
@@ -377,13 +562,20 @@ class WorkerService:
                 # unwieldy for match filters).
                 fault_plane.fire("worker.actor.exec", name=name,
                                  method=method_name)
-                args, kwargs = self._resolve(args_blob)
+                args, kwargs = self._resolve(args_blob, inline_args)
                 m = getattr(self.actor_instance, method_name)
                 result = m(*args, **kwargs)
-                self._store_returns(task_id, num_returns, result)
+                self._store_returns(task_id, num_returns, result, collect)
             except BaseException as e:  # noqa: BLE001
                 err = repr(e)
-                self._fail_returns(task_id, num_returns, e, name)
+                if collect is not None:
+                    collect[:] = []
+                try:
+                    self._fail_returns(task_id, num_returns, e, name,
+                                       collect)
+                except BaseException:  # noqa: BLE001 - injected dbl fault
+                    if collect is not None:
+                        collect[:] = []
             return err
 
         def take_over_pins():
@@ -394,7 +586,6 @@ class WorkerService:
             _taken_pins so a kill before execution releases them."""
             if not arg_pins:
                 return
-            from ray_tpu.core import refs as _refs_mod
             t = _refs_mod._tracker
             if t is not None:
                 t.pin_all(arg_pins)
@@ -409,7 +600,7 @@ class WorkerService:
                 try:
                     loop = asyncio.get_running_loop()
                     args, kwargs = await loop.run_in_executor(
-                        None, lambda: self._resolve(args_blob))
+                        None, lambda: self._resolve(args_blob, inline_args))
                     m = getattr(self.actor_instance, method_name)
                     result = m(*args, **kwargs)
                     if inspect.isawaitable(result):
@@ -447,19 +638,25 @@ class WorkerService:
             self._done_turn(caller_id, seqno)
             return {"ok": True, "enqueued": True}
         else:
+            # Sync actors ack AFTER execution, so the reply can carry the
+            # small returns inline (same contract as push_task_batch); the
+            # caller's call_async future completes with the value in hand.
+            # enqueued/duplicate acks above carry NO returns — the caller
+            # falls back to observing the store.
             if not self._wait_turn(caller_id, seqno):
                 return {"ok": True, "duplicate": True}
+            entries: list = []
             try:
-                error = run_sync()
+                error = run_sync(entries)
             finally:
                 self._done_turn(caller_id, seqno)
             self._flush_refs()
+            self._queue_seals([entries])
         self.events.record(task_id, name, "actor_task", start, time.time(),
                            error)
-        return {"ok": True}
+        return {"ok": True, "node_id": self.node_id, "returns": entries}
 
     def _release_taken_pins(self) -> None:
-        from ray_tpu.core import refs as _refs_mod
         t = _refs_mod._tracker
         with self._seq_lock:
             pins, self._taken_pins = self._taken_pins, {}

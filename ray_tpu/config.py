@@ -114,8 +114,26 @@ def all_flags() -> Dict[str, Any]:
 # Object store
 define("object_store_memory_mb", int, 2048, "Per-node shm object store capacity.")
 define("max_inline_object_bytes", int, 100 * 1024,
-       "Results/args at or below this size travel inline in RPCs instead of "
-       "through the shared-memory store (reference: max_direct_call_object_size).")
+       "THE single small-object threshold (reference: "
+       "max_direct_call_object_size). Values at or below this size travel "
+       "inline everywhere: store puts/gets use the one-round-trip inline "
+       "ops (ObjectPlane.put_value/put_blob, get_inline), task returns ride "
+       "the push reply (reply-carried results, sealed lazily), and task "
+       "args ship inside the task spec instead of put+pin+dependency-gate.")
+define("task_inline_returns", bool, True,
+       "Serialize task/actor results <= max_inline_object_bytes straight "
+       "into the push_task/push_actor_task reply; the caller seeds its "
+       "inline cache from the reply so get() touches no store/conductor. "
+       "The worker still seals the value into the store lazily so remote "
+       "pulls, wait() and lineage reconstruction keep working.")
+define("task_inline_args", bool, True,
+       "Ship top-level ObjectRef args whose serialized value is <= "
+       "max_inline_object_bytes inside the task spec (reference: in-spec "
+       "small args), skipping the dependency gate and the worker-side "
+       "store fetch for them.")
+define("inline_cache_max_bytes", int, 64 * 1024 * 1024,
+       "Byte budget of the caller-side LRU cache of reply-carried inline "
+       "results; entries are dropped when the local refcount hits zero.")
 define("object_spill_dir", str, "", "Directory for spilled objects ('' = session dir).")
 define("object_store_eviction_watermark", float, 0.8,
        "Fraction of store capacity above which LRU eviction of unreferenced "
@@ -211,6 +229,10 @@ define("fault_seed", int, 0,
 
 # Transport
 define("rpc_connect_timeout_s", float, 10.0, "Client connect timeout.")
+define("rpc_same_host_uds", bool, True,
+       "Mirror every RPC listener on a Unix socket and let loopback "
+       "clients use it instead of TCP (cheaper send syscalls on the task "
+       "push ping-pong). Off forces pure-TCP transport everywhere.")
 define("gcs_rpc_reconnect_s", float, 5.0,
        "Seconds drivers/planes retry conductor calls across a failover "
        "window (0 disables; parity gcs_rpc_server_reconnect_timeout_s).")

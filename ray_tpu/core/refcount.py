@@ -46,6 +46,12 @@ class RefTracker:
         self._cli = conductor_client
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # Optional hook fired (outside the lock) with the 16B store key
+        # when the process-local handle count for an object hits zero —
+        # the runtime wires it to the object plane's inline-cache eviction
+        # so reply-carried results are dropped as soon as the owner stops
+        # referencing them (no leak when the ref dies before lazy seal).
+        self.on_zero = None
         # oid binary (20B) -> number of live ObjectRef handles here
         self._local: Dict[bytes, int] = {}
         # store key (16B) -> live explicit pins from this process (kept so
@@ -77,13 +83,22 @@ class RefTracker:
                 self._append_event((store_key(oid), 1))
 
     def handle_dropped(self, oid: bytes) -> None:
+        zero = False
         with self._cv:
             c = self._local.get(oid, 0) - 1
             if c <= 0:
                 self._local.pop(oid, None)
                 self._append_event((store_key(oid), -1))
+                zero = True
             else:
                 self._local[oid] = c
+        if zero:
+            cb = self.on_zero
+            if cb is not None:
+                try:
+                    cb(store_key(oid))
+                except Exception:
+                    pass  # may run from __del__ during interpreter teardown
 
     def holds(self, oid: bytes) -> bool:
         """True while this process has live handles to ``oid`` (used by the
@@ -104,6 +119,37 @@ class RefTracker:
                 self._append_event((k, 1))
         if flush:
             self.flush()
+
+    def pins_need_sync(self, keys: List[bytes]) -> bool:
+        """Whether pinning ``keys`` must flush synchronously before the
+        refs travel. The sync flush in pin_all exists to make this
+        process's +1s durable before a borrower's transient +1/-1 pair can
+        reach the conductor; when NO buffered/unacked event touches these
+        keys, their handle-created +1s (the caller provably holds a live
+        handle per arg ref) are already durable, so the count can never
+        transit zero and the pin may ride the ordered 5ms stream instead
+        of paying a conductor round trip per call."""
+        # An in-flight flush has MOVED events out of the buffer without
+        # them being durable yet — holding _flush_lock for the check rules
+        # that window out (same lock order as flush: _flush_lock, _lock).
+        if not self._flush_lock.acquire(blocking=False):
+            return True
+        try:
+            with self._lock:
+                if self._pending_batch is not None or \
+                        self._epoch == "force-resync":
+                    return True
+                if not self._events:
+                    return False
+                ks = set(keys)
+                for k, v in self._events:
+                    if k in ks:
+                        return True
+                    if isinstance(v, list) and not ks.isdisjoint(v):
+                        return True
+            return False
+        finally:
+            self._flush_lock.release()
 
     def unpin_all(self, keys: List[bytes]) -> None:
         with self._lock:

@@ -37,15 +37,17 @@ from ray_tpu.core.actor import ActorHandle
 from ray_tpu.core.exceptions import (ActorDiedError, GetTimeoutError,
                                      ObjectLostError, TaskCancelledError,
                                      TaskError)
-from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
+                              WorkerID, store_key)
 from ray_tpu.core.options import ActorOptions, TaskOptions
 from ray_tpu.core.refs import ObjectRef
-from ray_tpu.core.task_spec import FunctionDescriptor
+from ray_tpu.core.task_spec import FunctionDescriptor, top_level_ref_args
 from ray_tpu.runtime_env import env_fingerprint as _env_fingerprint
 
 _LEASE_LINGER_S = 0.25     # idle lease kept briefly for reuse
 _MAX_LEASES_PER_KEY = 64
 _PUSH_BATCH = 32           # tasks coalesced per push RPC when queues are deep
+_ACTOR_PUSH_WINDOW = 32    # actor calls in flight per ordered channel
 
 
 class _LeasedWorker:
@@ -85,7 +87,11 @@ class _TaskRecord:
         self.solo = False
 
     def nbytes(self) -> int:
-        return len(self.task.get("args_blob") or b"")
+        n = len(self.task.get("args_blob") or b"")
+        inline = self.task.get("inline_args")
+        if inline:
+            n += sum(len(b) for b in inline.values())
+        return n
 
 
 class _GetFailure:
@@ -184,10 +190,12 @@ class TaskSubmitter:
             self._maybe_evict_lineage()
         deps = task.get("deps")
         if deps:
-            # Fast path: deps already sealed in the LOCAL store skip the
-            # gate entirely (common case: chained tasks on one node).
+            # Fast path: deps already sealed in the LOCAL store (or riding
+            # the inline cache, for reply-carried results awaiting their
+            # lazy seal) skip the gate entirely (common case: chained
+            # tasks on one node).
             try:
-                if all(self.rt.plane.store.contains(d) for d in deps):
+                if all(self.rt.plane.contains_key(d) for d in deps):
                     self._enqueue(rec)
                     return
             except Exception:
@@ -262,7 +270,7 @@ class TaskSubmitter:
                 last_key, last_sum = dep_key, sum(exist)
                 for rec in batch:
                     if all(exists.get(d) or
-                           self.rt.plane.store.contains(d)
+                           self.rt.plane.contains_key(d)
                            for d in rec.task["deps"]):
                         ready.append(rec)
             except Exception:
@@ -317,7 +325,10 @@ class TaskSubmitter:
                         self._lease_pool.submit(self._acquire_lease, st,
                                                 dict(rec0.task))
                     return
-            self._pool.submit(self._run_on, st, w, recs)
+            # _run_on is non-blocking now (call_async + reply callback), so
+            # dispatch INLINE: the pool handoff it replaced cost a thread
+            # wake per push on the ping-pong critical path.
+            self._run_on(st, w, recs)
 
     def _acquire_lease(self, st: _KeyState, task: dict) -> None:
         from ray_tpu.core.exceptions import RuntimeEnvSetupError
@@ -379,6 +390,12 @@ class TaskSubmitter:
 
     def _run_on(self, st: _KeyState, w: _LeasedWorker,
                 recs: List[_TaskRecord]) -> None:
+        """Issue the push RPC without blocking a pool thread on the reply:
+        call_async pipelines the request and _push_done consumes the reply
+        (reply-carried return values included) on the channel's reader
+        thread. A driver saturating one worker no longer serializes on
+        push round trips — the next batch is in flight while the previous
+        executes."""
         # Destination is known now: proactively stream LOCAL arg objects to
         # the target node (push_manager.h role; best-effort, async) so the
         # worker's arg resolution finds them in its own store instead of
@@ -387,65 +404,98 @@ class TaskSubmitter:
             for rec in recs:
                 for dep in rec.task.get("deps") or ():
                     self.rt.push_mgr.maybe_push(dep, w.daemon_address)
+        tasks = [{"task_id": r.task["task_id"],
+                  "function_id": r.task["function_id"],
+                  "args_blob": r.task["args_blob"],
+                  "num_returns": r.task["num_returns"],
+                  "name": r.task["name"],
+                  **({"inline_args": r.task["inline_args"]}
+                     if r.task.get("inline_args") else {}),
+                  **({"trace_ctx": r.task["trace_ctx"]}
+                     if "trace_ctx" in r.task else {})}
+                 for r in recs]
         try:
-            get_client(w.address).call(
-                "push_task_batch",
-                tasks=[{"task_id": r.task["task_id"],
-                        "function_id": r.task["function_id"],
-                        "args_blob": r.task["args_blob"],
-                        "num_returns": r.task["num_returns"],
-                        "name": r.task["name"],
-                        **({"trace_ctx": r.task["trace_ctx"]}
-                           if "trace_ctx" in r.task else {})}
-                       for r in recs])
-            for rec in recs:
-                rec.done = True
-                self._unpin_args(rec)
+            fut = get_client(w.address).call_async("push_task_batch",
+                                                   tasks=tasks)
         except (ConnectionLost, OSError, RpcError):
-            w.alive = False
-            from ray_tpu.cluster.protocol import drop_client
-            drop_client(w.address)  # pooled sockets are stale now
-            self.rt._drop_lease(w)
-            with st.lock:
-                st.busy -= 1
-                st.active.discard(w)
-            # Only a SOLO failure charges the task's retries: a worker dying
-            # under a batch doesn't identify the culprit, so batch-mates
-            # resubmit solo and uncharged.
-            charged = [rec for rec in recs
-                       if len(recs) == 1 and rec.retries_left == 0]
-            retriable = [rec for rec in recs if rec not in charged]
-            if retriable:
-                # brief backoff so the daemon's reaper notices the dead
-                # worker before the retry re-leases (avoids burning every
-                # retry on the same dying process)
-                time.sleep(0.25)
+            self._push_failed(st, w, recs)
+            return
+        except BaseException as e:  # noqa: BLE001 - surfaced via refs
+            self._push_errored(st, w, recs, e)
+            return
+        fut.add_done_callback(lambda f: self._push_done(st, w, recs, f))
+
+    def _push_done(self, st: _KeyState, w: _LeasedWorker,
+                   recs: List[_TaskRecord], fut) -> None:
+        """Reply handler for an async push (runs on the RPC reader thread:
+        must not block on locks held across RPCs or sleep)."""
+        try:
+            resp = fut.result()
+        except (ConnectionLost, OSError, RpcError):
+            self._push_failed(st, w, recs)
+            return
+        except BaseException as e:  # noqa: BLE001 - surfaced via refs
+            self._push_errored(st, w, recs, e)
+            return
+        returns = (resp or {}).get("returns") or {}
+        node_id = (resp or {}).get("node_id")
+        for rec in recs:
+            rec.done = True
+            self.rt._seed_returns(rec.task,
+                                  returns.get(rec.task["task_id"]), node_id)
+            self._unpin_args(rec)
+        with st.lock:
+            st.busy -= 1
+            st.active.discard(w)
+        self._return_worker(st, w)
+
+    def _push_failed(self, st: _KeyState, w: _LeasedWorker,
+                     recs: List[_TaskRecord]) -> None:
+        """Infrastructure failure of a push (worker dead / channel lost)."""
+        w.alive = False
+        from ray_tpu.cluster.protocol import drop_client
+        drop_client(w.address)  # pooled sockets are stale now
+        self.rt._drop_lease(w)
+        with st.lock:
+            st.busy -= 1
+            st.active.discard(w)
+        # Only a SOLO failure charges the task's retries: a worker dying
+        # under a batch doesn't identify the culprit, so batch-mates
+        # resubmit solo and uncharged.
+        charged = [rec for rec in recs
+                   if len(recs) == 1 and rec.retries_left == 0]
+        retriable = [rec for rec in recs if rec not in charged]
+
+        def _requeue() -> None:
             for rec in retriable:
                 if len(recs) == 1 and rec.retries_left > 0:
                     rec.retries_left -= 1
                 rec.solo = True
                 self._enqueue(rec)
-            for rec in charged:
-                err = TaskError.from_exception(
-                    ObjectLostError(rec.task["task_id"].hex(),
-                                    "worker died and no retries left"),
-                    rec.task["name"])
-                self.rt._store_error_returns(rec.task, err)
-                self._unpin_args(rec)
-            return
-        except BaseException as e:  # noqa: BLE001 - surfaced via refs
-            with st.lock:
-                st.busy -= 1
-                st.active.discard(w)
-            for rec in recs:
-                self.rt._store_error_returns(
-                    rec.task, TaskError.from_exception(e, rec.task["name"]))
-                self._unpin_args(rec)
-            self._return_worker(st, w)
-            return
+
+        if retriable:
+            # Brief backoff so the daemon's reaper notices the dead worker
+            # before the retry re-leases. A Timer, not a sleep: this path
+            # may run on the RPC channel's reader thread, where a sleep
+            # would stall every other reply on the channel.
+            threading.Timer(0.25, _requeue).start()
+        for rec in charged:
+            err = TaskError.from_exception(
+                ObjectLostError(rec.task["task_id"].hex(),
+                                "worker died and no retries left"),
+                rec.task["name"])
+            self.rt._store_error_returns(rec.task, err)
+            self._unpin_args(rec)
+
+    def _push_errored(self, st: _KeyState, w: _LeasedWorker,
+                      recs: List[_TaskRecord], e: BaseException) -> None:
         with st.lock:
             st.busy -= 1
             st.active.discard(w)
+        for rec in recs:
+            self.rt._store_error_returns(
+                rec.task, TaskError.from_exception(e, rec.task["name"]))
+            self._unpin_args(rec)
         self._return_worker(st, w)
 
     def _return_worker(self, st: _KeyState, w: _LeasedWorker) -> None:
@@ -473,6 +523,21 @@ class TaskSubmitter:
         if key in _seen:
             return True
         _seen.add(key)
+        # Reply-carried copy still in this process's inline cache: reseal
+        # it into the local store directly — the cached blob IS the value,
+        # so no re-execution (or even a worker) is needed.
+        skey = store_key(key)
+        blob = self.rt.plane.inline_blob(skey)
+        if blob is not None:
+            try:
+                self.rt.conductor.call("ref_revive", keys=[skey])
+            except Exception:
+                pass
+            try:
+                self.rt.plane.put_blob(ObjectID(key), bytes(blob))
+                return True
+            except Exception:
+                pass
         rec = self._lineage.get(key)
         if rec is None:
             return False
@@ -486,7 +551,6 @@ class TaskSubmitter:
         # The outputs may have been GC-freed (tombstoned) since: clear the
         # tombstones so the reconstructed copies can register locations.
         try:
-            from ray_tpu.core.ids import store_key
             tid = TaskID(rec.task["task_id"])
             revive = [store_key(tid.object_id_for_return(i).binary())
                       for i in range(rec.task["num_returns"])]
@@ -620,21 +684,24 @@ class _ActorClient:
                         self.rt._store_error_returns(t, self.death_error)
                         self.rt._unpin_task(t)
                     return
-                task = self.queue.popleft()
+                batch = []
+                while self.queue and len(batch) < _ACTOR_PUSH_WINDOW:
+                    batch.append(self.queue.popleft())
             try:
-                self._push_one(task)
+                self._push_window(batch)
             except BaseException as e:  # noqa: BLE001 - must not kill pusher
-                # An unexpected error escaping _push_one would silently end
-                # this thread and strand every queued task; fail the task's
-                # refs instead and keep pumping.
-                try:
-                    self.rt._store_error_returns(
-                        task, TaskError.from_exception(
-                            e, f"{self.class_name}.{task['method_name']}"))
-                except Exception:
-                    pass
-            finally:
-                self.rt._unpin_task(task)
+                # An unexpected error escaping the window would silently
+                # end this thread and strand every queued task; fail the
+                # batch's refs instead and keep pumping.
+                for task in batch:
+                    try:
+                        self.rt._store_error_returns(
+                            task, TaskError.from_exception(
+                                e,
+                                f"{self.class_name}.{task['method_name']}"))
+                    except Exception:
+                        pass
+                    self.rt._unpin_task(task)
 
     def _resolve_address(self, timeout: float = 300.0) -> bool:
         err = self.rt._reg_failed.pop(self.actor_id, None)
@@ -670,48 +737,92 @@ class _ActorClient:
             return False
         return False
 
-    def _push_one(self, task: dict) -> None:
-        """Push with reference retry semantics: the sequence number commits
-        only after a successful push, so a retried push resends the SAME
-        seqno (the worker dedupes already-executed seqnos); a fresh
-        incarnation resets ordering via _resolve_address."""
-        attempt = 0
-        while True:
-            while self.address is None:
+    def _ack_one(self, task: dict, fut) -> None:
+        """Reply callback, run on the channel's reader thread: seed the
+        caller's object plane from the reply and release the argument pins
+        the moment the ack lands — a sync caller parked in rt.get() wakes
+        here, without waiting for the pusher thread to be scheduled.
+        Failed futures are ignored; the pusher owns retries."""
+        try:
+            resp = fut.result()
+        except BaseException:  # noqa: BLE001 - pusher handles the failure
+            return
+        self.rt._seed_returns(task, (resp or {}).get("returns"),
+                              (resp or {}).get("node_id"))
+        self.rt._unpin_task(task)
+
+    def _push_window(self, batch: List[dict]) -> None:
+        """Windowed pipelined push with reference retry semantics.
+
+        Every task's frame goes out back-to-back on the per-actor ordered
+        channel — the worker executes same-channel frames in submission
+        order, so acks come back in order and the pusher never waits a
+        round trip per call. Sequence numbers are assigned at send and
+        commit per-ack: a failure rewinds to the last acked task and
+        resends the unacked suffix (same seqnos — the worker dedupes
+        already-executed ones; a fresh incarnation resets ordering via
+        _resolve_address)."""
+        while batch:
+            if self.address is None or self.dead:
                 if not self._resolve_address():
                     if self.dead:
-                        self.rt._store_error_returns(task, self.death_error)
+                        for task in batch:
+                            self.rt._store_error_returns(
+                                task, self.death_error)
+                            self.rt._unpin_task(task)
                         return
                     continue
-            seq = self.seqno
+            cli = get_client(self.address)
+            base = self.seqno
+            futs = []
             try:
-                get_client(self.address).call(
-                    "push_actor_task", task_id=task["task_id"],
-                    caller_id=self.rt.caller_id, seqno=seq,
-                    method_name=task["method_name"],
-                    args_blob=task["args_blob"],
-                    num_returns=task["num_returns"],
-                    arg_pins=task.get("pin_keys") or [],
-                    actor_id=self.actor_id)
-                self.seqno = seq + 1
+                for i, task in enumerate(batch):
+                    f = cli.call_async(
+                        "push_actor_task", task_id=task["task_id"],
+                        caller_id=self.rt.caller_id, seqno=base + i,
+                        method_name=task["method_name"],
+                        args_blob=task["args_blob"],
+                        num_returns=task["num_returns"],
+                        arg_pins=task.get("pin_keys") or [],
+                        inline_args=task.get("inline_args"),
+                        actor_id=self.actor_id)
+                    f.add_done_callback(
+                        lambda f, t=task: self._ack_one(t, f))
+                    futs.append(f)
+            except BaseException:  # noqa: BLE001 - channel died mid-send
+                pass
+            acked = 0
+            failed = False
+            for task, f in zip(batch, futs):
+                try:
+                    f.result()
+                except BaseException:  # noqa: BLE001 - infra failure
+                    failed = True
+                    break
+                self.seqno += 1
+                acked += 1
+            if not failed and acked == len(batch):
                 return
-            except Exception:
-                # Any failure here is infrastructure (user exceptions are
-                # delivered via the object store, never raised through the
-                # push RPC): stale address, dying worker, or a restart race
-                # ("no actor hosted on this worker"). Re-resolve and retry
-                # within the task's budget.
-                self.address = None
-                attempt += 1
-                max_task_retries = task.get("max_task_retries", 0)
-                if max_task_retries == 0 or (
-                        0 < max_task_retries < attempt):
-                    self.rt._store_error_returns(
-                        task, TaskError.from_exception(
-                            ActorDiedError(self.class_name,
-                                           "actor worker unreachable"),
-                            f"{self.class_name}.{task['method_name']}"))
-                    return
+            # Any failure here is infrastructure (user exceptions are
+            # delivered via the object refs, never raised through the push
+            # RPC): stale address, dying worker, or a restart race. Retry
+            # the unacked suffix within the HEAD task's budget — charging
+            # only the task at the failure point mirrors the serial
+            # pusher's one-task-per-attempt accounting.
+            batch = batch[acked:]
+            self.address = None
+            head = batch[0]
+            head["_push_attempts"] = head.get("_push_attempts", 0) + 1
+            max_task_retries = head.get("max_task_retries", 0)
+            if max_task_retries == 0 or (
+                    0 < max_task_retries < head["_push_attempts"]):
+                self.rt._store_error_returns(
+                    head, TaskError.from_exception(
+                        ActorDiedError(self.class_name,
+                                       "actor worker unreachable"),
+                        f"{self.class_name}.{head['method_name']}"))
+                self.rt._unpin_task(head)
+                batch = batch[1:]
 
 
 class ClusterRuntime:
@@ -846,7 +957,14 @@ class ClusterRuntime:
         from ray_tpu.core import refcount
         from ray_tpu.core import refs as _refs_mod
         self._ref_tracker = refcount.RefTracker(self.conductor)
+        # Reply-carried inline results leave the cache the moment the
+        # local refcount hits zero — no leak when the caller drops its
+        # ref before the producer's lazy seal lands.
+        self._ref_tracker.on_zero = self.plane.drop_inline
         _refs_mod._tracker = self._ref_tracker
+        # inline-arg flag cache (config.get walks os.environ; hot path)
+        self._iargs_gen = None
+        self._iargs_on = True
         # Worker stdout/stderr -> this driver (log_monitor.py role). Only
         # true drivers subscribe: a worker echoing the channel into its own
         # captured stdout would feed back into the channel.
@@ -995,6 +1113,34 @@ class ClusterRuntime:
                 self.plane.put_value(oid, err)
             except Exception:
                 pass
+            # Wake getters parked on a push reply that will never come;
+            # they re-read and find the error in the store.
+            self.plane.resolve_pending(self.plane._key(oid))
+
+    def _seed_returns(self, task: dict, entries: Optional[list],
+                      node_id: Optional[bytes]) -> None:
+        """Complete this task's return refs straight from the push reply.
+
+        Reply entries line up with ``return_oids``: ``{"data": blob}``
+        carries an inline result (the producer seals it into its store
+        lazily), ``{"stored": True}`` means the value is store-backed.
+        Either way the return key stops being reply-pending, so getters
+        parked by add_pending move on. Inline blobs are only cached while
+        somebody here still holds the ref — and the producer's node is
+        pre-registered in the directory so remote consumers discover the
+        lazily-sealed copy (or get a deterministic lost verdict if the
+        producer dies before sealing)."""
+        oids = task.get("return_oids") or ()
+        entries = entries or ()
+        tracker = self._ref_tracker
+        for i, ob in enumerate(oids):
+            key = store_key(ob)
+            e = entries[i] if i < len(entries) else None
+            data = e.get("data") if isinstance(e, dict) else None
+            if data is not None and tracker.holds(ob):
+                self.plane.seed_inline(key, data, producer_node=node_id)
+            else:
+                self.plane.resolve_pending(key)
 
     def _prewait(self, refs: List[ObjectRef], deadline: Optional[float],
                  budget_s: float = 4.0) -> None:
@@ -1028,13 +1174,12 @@ class ClusterRuntime:
             timeout: Optional[float] = None) -> List[Any]:
         from ray_tpu.cluster.object_plane import MISS
         deadline = None if timeout is None else time.monotonic() + timeout
-        if len(refs) > 4:
-            self._prewait(refs, deadline)
         if len(refs) <= 1:
             return [self._get_one(ref, deadline) for ref in refs]
-        # Batch fast path: one store round trip resolves every LOCAL
-        # sealed small object (the dominant shape — a get() over many task
-        # results). Misses fall through to the concurrent per-object path.
+        # Batch fast path FIRST: the inline cache plus one store round trip
+        # resolves every reply-carried or locally sealed small object (the
+        # dominant shape — a get() over many task results) with zero
+        # conductor traffic. Misses fall through to the per-object path.
         try:
             results = self.plane.get_values_local_inline(
                 [r.id for r in refs])
@@ -1042,6 +1187,13 @@ class ClusterRuntime:
             results = [MISS] * len(refs)
         missing = [i for i, v in enumerate(results) if v is MISS]
         if missing:
+            # Directory prewait only helps refs that are NOT parked on a
+            # push reply (pending refs resolve from the reply, and their
+            # locations may not register until the producer's lazy seal).
+            hard = [refs[i] for i in missing
+                    if not self.plane.is_pending(self.plane._key(refs[i].id))]
+            if len(hard) > 4:
+                self._prewait(hard, deadline)
             # Resolve concurrently: N remote objects fetch in parallel (the
             # reference's Get batches plasma fetches the same way) and a
             # lost object's recovery clock starts immediately instead of
@@ -1066,11 +1218,19 @@ class ClusterRuntime:
 
     def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         waited = 0.0
+        key = self.plane._key(ref.id)
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 raise GetTimeoutError(f"Get timed out waiting for {ref}")
             step = 2.0 if remaining is None else min(2.0, remaining)
+            # A return still awaiting its push reply parks HERE (one CV
+            # wait, woken by seed/resolve) instead of polling the store
+            # and long-polling the directory for a location that may not
+            # exist until the producer's lazy seal.
+            if self.plane.is_pending(key) and \
+                    not self.plane.wait_inline(key, step):
+                continue
             try:
                 value = self.plane.get_value(ref.id, timeout=step)
             except (GetTimeoutError, ObjectLostError) as e:
@@ -1202,28 +1362,47 @@ class ClusterRuntime:
         task_id = TaskID.from_random()
         args_blob, all_refs = serialization.dumps_with_refs(
             (list(args), dict(kwargs)))
-        # Dependency gate covers exactly what the worker will inline:
-        # TOP-LEVEL ObjectRef args (_resolve in worker_main.py). Refs nested
-        # inside containers are passed through as refs (Ray semantics) and
-        # must NOT block dispatch — a monitor handed a list of in-progress
-        # refs has to start immediately.
-        dep_refs = [a for a in list(args) + list(kwargs.values())
-                    if isinstance(a, ObjectRef)]
-        deps = [self.plane._key(a.id) for a in dep_refs]
-        dep_oids = [a.id.binary() for a in dep_refs]
+        # Dependency gate covers exactly what the worker will materialize:
+        # TOP-LEVEL ObjectRef args (task_spec.top_level_ref_args — the one
+        # definition shared with the worker's resolver). Refs nested inside
+        # containers are passed through as refs (Ray semantics) and must
+        # NOT block dispatch — a monitor handed a list of in-progress refs
+        # has to start immediately. Args whose serialized value is already
+        # local and small travel INSIDE the spec (inline_args) and skip
+        # the gate entirely: the value rides the push RPC.
+        arg_refs = top_level_ref_args(args, kwargs)
+        inline_args, inlined = self._inline_args(arg_refs)
+        gate_refs = [a for a in arg_refs if a.id.binary() not in inlined]
+        deps = [self.plane._key(a.id) for a in gate_refs]
+        dep_oids = [a.id.binary() for a in gate_refs]
         # Pin EVERY ref reachable from the args (top-level and nested) for
         # the submit->execution window, so the argument objects survive the
         # caller dropping its own handles mid-flight (reference_count.h
         # in-flight argument references). Unpinned on ack/terminal failure.
         pin_keys = self._pin_arg_refs(all_refs)
-        resources = {"CPU": opts.num_cpus, "TPU": opts.num_tpus,
-                     **opts.resources}
-        resources = {k: v for k, v in resources.items() if v > 0}
-        strategy = self._strategy_dict(opts.scheduling_strategy)
-        # None -> config default; -1 -> retry forever (reference semantics)
-        max_retries = opts.max_retries
-        if max_retries is None:
-            max_retries = self.submitter._default_max_retries
+        # The opts-derived spec fields (resources, strategy dict, the
+        # scheduling-key tail, resolved retries) depend only on ``opts``,
+        # which is immutable-by-convention after construction (a
+        # RemoteFunction holds one instance; .options() builds a new one)
+        # — memoize them on the instance so a hot .remote() loop doesn't
+        # re-sort/re-repr/re-fingerprint identical values per call.
+        memo = getattr(opts, "_submit_memo", None)
+        if memo is None:
+            resources = {"CPU": opts.num_cpus, "TPU": opts.num_tpus,
+                         **opts.resources}
+            resources = {k: v for k, v in resources.items() if v > 0}
+            strategy = self._strategy_dict(opts.scheduling_strategy)
+            # None -> config default; -1 -> forever (reference semantics)
+            max_retries = opts.max_retries
+            if max_retries is None:
+                max_retries = self.submitter._default_max_retries
+            memo = opts._submit_memo = (
+                resources, strategy, max_retries,
+                (tuple(sorted(resources.items())), repr(strategy),
+                 _env_fingerprint(opts.runtime_env)))
+        resources, strategy, max_retries, key_tail = memo
+        rets = [task_id.object_id_for_return(i)
+                for i in range(opts.num_returns)]
         task = {
             "task_id": task_id.binary(),
             "function_id": desc.function_id,
@@ -1237,11 +1416,14 @@ class ClusterRuntime:
             "deps": deps,
             "dep_oids": dep_oids,
             "pin_keys": pin_keys,
-            "return_oids": [task_id.object_id_for_return(i).binary()
-                            for i in range(opts.num_returns)],
-            "key": (desc.function_id, tuple(sorted(resources.items())),
-                    repr(strategy), _env_fingerprint(opts.runtime_env)),
+            "return_oids": [r.binary() for r in rets],
+            "key": (desc.function_id,) + key_tail,
         }
+        if inline_args:
+            task["inline_args"] = inline_args
+        # Returns may arrive IN the push reply: getters park on the reply
+        # instead of polling the store/directory.
+        self.plane.add_pending([store_key(r.binary()) for r in rets])
         from ray_tpu.util import tracing
         if tracing.enabled():
             # Submit span (instant) + context propagated in the spec so
@@ -1254,9 +1436,58 @@ class ClusterRuntime:
                             "task_id": task_id.hex()})
             task["trace_ctx"] = ctx
             tracing.flush(self.conductor)
+        # Return refs are constructed BEFORE the push: the reply can beat
+        # this function's tail (inline dispatch + a fast worker), and
+        # _seed_returns only caches blobs while tracker.holds() — a ref
+        # created after the reply would miss its seed and demote the get
+        # to the store-observation slow path.
+        out = [ObjectRef(r, owner=self.address) for r in rets]
         self.submitter.submit(task)
-        return [ObjectRef(task_id.object_id_for_return(i), owner=self.address)
-                for i in range(opts.num_returns)]
+        return out
+
+    def _inline_args_on(self) -> bool:
+        if self._iargs_gen != config.generation:
+            self._iargs_on = bool(config.get("task_inline_args"))
+            self._iargs_gen = config.generation
+        return self._iargs_on
+
+    def _inline_args(self, arg_refs: List[ObjectRef]):
+        """Resolve small already-available args to blobs riding the task
+        spec (reference parity: in-spec inlined args of the direct call
+        path). Returns ({store_key: blob}, {inlined oid binaries}). Only
+        TOP-LEVEL refs qualify (nested refs stay refs); values come from
+        the caller's inline cache (a reply-carried result being chained
+        into the next task — the hot pipeline shape) or from the local
+        store in ONE batched round trip. Inlined refs skip the dependency
+        gate: the value travels with the task."""
+        if not arg_refs or not self._inline_args_on():
+            return {}, set()
+        limit = self.plane._inline_max()
+        out: Dict[bytes, bytes] = {}
+        inlined: set = set()
+        need: List[ObjectRef] = []
+        for r in arg_refs:
+            key = self.plane._key(r.id)
+            if key in out:
+                inlined.add(r.id.binary())
+                continue
+            blob = self.plane.inline_blob(key)
+            if blob is not None and len(blob) <= limit:
+                out[key] = bytes(blob)
+                inlined.add(r.id.binary())
+            else:
+                need.append(r)
+        if need:
+            try:
+                blobs = self.plane.store.get_inline_batch(
+                    [self.plane._key(r.id) for r in need], max_bytes=limit)
+            except Exception:
+                blobs = [None] * len(need)
+            for r, b in zip(need, blobs):
+                if b is not None:
+                    out[self.plane._key(r.id)] = bytes(b)
+                    inlined.add(r.id.binary())
+        return out, inlined
 
     def _pin_arg_refs(self, arg_refs: List[ObjectRef]) -> List[bytes]:
         from ray_tpu.core import refs as _refs_mod
@@ -1264,9 +1495,12 @@ class ClusterRuntime:
         if tracker is None or not arg_refs:
             return []
         keys = [self.plane._key(r.id) for r in arg_refs]
-        # Synchronous flush inside pin_all: the owner's +1s (and these
-        # pins) must be durable before the refs travel (refcount.py).
-        tracker.pin_all(keys)
+        # The owner's +1s (and these pins) must be durable before the refs
+        # travel — but when no buffered event touches these keys the
+        # handle +1s already ARE durable, and the pin events coalesce into
+        # the ordered 5ms stream instead of paying a conductor round trip
+        # per submit (pins_need_sync, refcount.py).
+        tracker.pin_all(keys, flush=tracker.pins_need_sync(keys))
         return keys
 
     def _unpin_task(self, task: dict) -> None:
@@ -1402,6 +1636,9 @@ class ClusterRuntime:
         args_blob, all_refs = serialization.dumps_with_refs(
             (list(args), dict(kwargs)))
         meta = self._actor_meta.get(actor_id, {})
+        inline_args, _ = self._inline_args(top_level_ref_args(args, kwargs))
+        return_oids = [task_id.object_id_for_return(i).binary()
+                       for i in range(opts.num_returns)]
         task = {
             "task_id": task_id.binary(),
             "method_name": method_name,
@@ -1409,7 +1646,11 @@ class ClusterRuntime:
             "num_returns": opts.num_returns,
             "max_task_retries": meta.get("max_task_retries", 0),
             "pin_keys": self._pin_arg_refs(all_refs),
+            "return_oids": return_oids,
         }
+        if inline_args:
+            task["inline_args"] = inline_args
+        self.plane.add_pending([store_key(ob) for ob in return_oids])
         refs = [ObjectRef(task_id.object_id_for_return(i), owner=self.address)
                 for i in range(opts.num_returns)]
         with self._lock:

@@ -120,7 +120,8 @@ class LocalRuntime:
             fut = self._future_for(ref.id)
             try:
                 value = fut.result(timeout=remaining)
-            except TimeoutError:
+            except futures.TimeoutError:
+                # On 3.10 futures.TimeoutError is NOT the builtin TimeoutError.
                 raise GetTimeoutError(
                     f"Get timed out after {timeout}s waiting for {ref}")
             if isinstance(value, TaskError):

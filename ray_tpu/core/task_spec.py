@@ -15,6 +15,36 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core import serialization
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu.core.options import ActorOptions, TaskOptions
+from ray_tpu.core.refs import ObjectRef
+
+
+def top_level_ref_args(args, kwargs) -> List[ObjectRef]:
+    """The ONE definition of which task arguments the execution plane
+    resolves by value: ObjectRefs in a TOP-LEVEL positional or keyword
+    position (reference task_spec.h ByReference args; nested refs stay
+    refs and are merely borrowed). The submit side derives the dependency
+    gate and the in-spec arg inliner from this list, the worker derives
+    its arg resolution from ``resolve_task_args`` — both sides share this
+    helper so the two rules can never drift."""
+    out: List[ObjectRef] = []
+    for a in args:
+        if isinstance(a, ObjectRef):
+            out.append(a)
+    for v in kwargs.values():
+        if isinstance(v, ObjectRef):
+            out.append(v)
+    return out
+
+
+def resolve_task_args(args, kwargs, resolve_ref):
+    """Materialize the top-level ObjectRef arguments (and only those —
+    the mirror of ``top_level_ref_args``) via ``resolve_ref(ref)``.
+    Returns (args_list, kwargs_dict) ready to call the function with."""
+    res_args = [resolve_ref(a) if isinstance(a, ObjectRef) else a
+                for a in args]
+    res_kwargs = {k: resolve_ref(v) if isinstance(v, ObjectRef) else v
+                  for k, v in kwargs.items()}
+    return res_args, res_kwargs
 
 
 @dataclass

@@ -1,0 +1,28 @@
+"""jax version compatibility for ops kernels.
+
+`shard_map` graduated from `jax.experimental.shard_map` to a top-level
+`jax.shard_map` in newer jax, renaming `check_rep` to `check_vma` along the
+way; callers import `shard_map` from this module (new-jax kwarg spelling)
+and stay agnostic to the installed version.
+"""
+
+import functools
+
+import jax
+
+try:
+    axis_size = jax.lax.axis_size
+except AttributeError:  # jax < 0.5: axis_frame(name) IS the size (an int)
+    def axis_size(axis_name):
+        return jax.core.axis_frame(axis_name)
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.5: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    @functools.wraps(_shard_map_old)
+    def shard_map(f, *args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map_old(f, *args, **kwargs)

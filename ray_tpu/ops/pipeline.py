@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.ops._compat import axis_size, shard_map
+
 
 def pipeline_apply_local(stage_fn: Callable, stage_params: Any, x,
                          *, axis: str = "pp", num_microbatches: int):
@@ -33,7 +35,7 @@ def pipeline_apply_local(stage_fn: Callable, stage_params: Any, x,
     Returns [num_microbatches, mb, ...] outputs, replicated (materialized on
     the last rank, broadcast at the end).
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     rank = lax.axis_index(axis)
     m = num_microbatches
     perm = [(i, (i + 1) % n) for i in range(n)]  # rank r -> r+1
@@ -88,5 +90,5 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, mesh: Mesh, *,
         return pipeline_apply_local(stage_fn, sp, xx, axis=axis,
                                     num_microbatches=num_microbatches)
 
-    return jax.shard_map(body, mesh=mesh, in_specs=(p_spec, x_spec),
-                         out_specs=x_spec, check_vma=False)(stage_params, x)
+    return shard_map(body, mesh=mesh, in_specs=(p_spec, x_spec),
+                     out_specs=x_spec, check_vma=False)(stage_params, x)

@@ -24,11 +24,20 @@ from typing import Any, Dict, List, Optional
 
 _buffer: List[dict] = []
 _lock = threading.Lock()
+_enabled_gen: Optional[int] = None
+_enabled_v = False
 
 
 def enabled() -> bool:
+    # Cached against the config generation: this flag read sits on every
+    # task submission (config.get walks os.environ — measurable at
+    # thousands of submits/s).
+    global _enabled_gen, _enabled_v
     from ray_tpu import config
-    return bool(config.get("tracing_enabled"))
+    if _enabled_gen != config.generation:
+        _enabled_v = bool(config.get("tracing_enabled"))
+        _enabled_gen = config.generation
+    return _enabled_v
 
 
 def new_context(parent: Optional[dict] = None) -> dict:
