@@ -105,6 +105,13 @@ class Conductor:
         self._free_cv = threading.Condition()
         self._pgs: Dict[bytes, PlacementGroupInfo] = {}
         self._task_events: List[dict] = []
+        # Flight-recorder event store (util/events.py sink; parity role:
+        # GcsTaskManager's bounded task-event store). Own lock: batches
+        # arrive from every process's flusher/heartbeat and must not
+        # contend with the control tables.
+        self._ring_lock = threading.Lock()
+        self._ring_events: List[dict] = []
+        self._ring_dropped = 0
         self._job_counter = 0
         self._health_timeout_s = health_timeout_s
         self._stopped = False
@@ -407,8 +414,8 @@ class Conductor:
 
     def rpc_heartbeat(self, node_id: bytes,
                       resources_available: Dict[str, float],
-                      pending_demand: Optional[List[Dict[str, float]]] = None
-                      ) -> dict:
+                      pending_demand: Optional[List[Dict[str, float]]] = None,
+                      events: Optional[dict] = None) -> dict:
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info["alive"]:
@@ -417,6 +424,13 @@ class Conductor:
             info["last_heartbeat"] = time.monotonic()
             info["resources_available"] = dict(resources_available)
             info["pending_demand"] = list(pending_demand or [])
+        if events:
+            # Flight-recorder piggyback: the daemon rides its ring delta
+            # on the heartbeat it already pays for (events.heartbeat_payload).
+            self.rpc_push_ring_events(
+                node_id=node_id.hex(), pid=events.get("pid", 0),
+                events=events.get("events", ()),
+                dropped=events.get("dropped", 0))
         return {"ok": True, "epoch": self._epoch}
 
     def rpc_cluster_load(self) -> dict:
@@ -514,6 +528,16 @@ class Conductor:
             self._cv.notify_all()
         for a in to_restart:
             self._on_actor_death(a.actor_id, f"node died: {reason}")
+        # Reap the dead node's per-process metrics snapshots: the KV keys
+        # are (node, pid)-scoped, so a node's death identifies exactly its
+        # entries (util/metrics.py satellite — stale keys used to linger
+        # forever and shadow reused pids).
+        prefix = f"proc-{node_id.hex()}-".encode()
+        with self._lock:
+            stale = [k for (n, k) in self._kv
+                     if n == "metrics" and k.startswith(prefix)]
+            for k in stale:
+                self._kv.pop(("metrics", k), None)
         # Re-place any PGs knocked back to PENDING.
         with self._lock:
             pending = [pg for pg in self._pgs.values() if pg.state == "PENDING"]
@@ -1424,6 +1448,66 @@ class Conductor:
         if trace_id:
             spans = [s for s in spans if s["trace_id"] == trace_id]
         return spans
+
+    # Flight-recorder event store (util/events.py sink; GcsTaskManager's
+    # bounded-store role for the compact ring events every plane emits).
+    def rpc_push_ring_events(self, node_id: str, pid: int, events,
+                             dropped: int = 0) -> dict:
+        recs = [{"ts": e[0], "kind": e[1], "ident": e[2], "value": e[3],
+                 "attrs": e[4], "node_id": node_id, "pid": pid}
+                for e in events]
+        with self._ring_lock:
+            self._ring_events.extend(recs)
+            self._ring_dropped += int(dropped)
+            if len(self._ring_events) > 200_000:
+                del self._ring_events[:len(self._ring_events) - 200_000]
+        return {"ok": True}
+
+    def rpc_get_ring_events(self, limit: int = 0,
+                            kind: Optional[str] = None) -> List[dict]:
+        with self._ring_lock:
+            evs = list(self._ring_events)
+        if kind:
+            evs = [e for e in evs
+                   if e["kind"] == kind or e["kind"].startswith(kind + ".")]
+        return evs[-limit:] if limit else evs
+
+    def rpc_debug_state(self) -> dict:
+        """Internal-table sizes + queue depths (raylet debug_state.txt
+        parity, conductor slice). Cheap: counts only, no copies."""
+        with self._lock:
+            nodes_alive = sum(1 for n in self._nodes.values() if n["alive"])
+            actor_states: Dict[str, int] = {}
+            for a in self._actors.values():
+                actor_states[a.state] = actor_states.get(a.state, 0) + 1
+            kv_ns: Dict[str, int] = {}
+            for (n, _k) in self._kv:
+                kv_ns[n] = kv_ns.get(n, 0) + 1
+            out = {
+                "role": "conductor",
+                "epoch": self._epoch,
+                "nodes_alive": nodes_alive,
+                "nodes_total": len(self._nodes),
+                "actors": actor_states,
+                "named_actors": len(self._named_actors),
+                "functions": len(self._functions),
+                "kv_keys_by_ns": kv_ns,
+                "object_locations": len(self._object_locations),
+                "objects_spilled": len(self._object_spilled),
+                "objects_lost": len(self._lost_objects),
+                "refcount_entries": len(self._refcounts),
+                "ref_tombstones": len(self._ref_tombstones),
+                "placement_groups": len(self._pgs),
+                "task_events": len(self._task_events),
+                "spans": len(getattr(self, "_spans", ())),
+            }
+        with self._free_cv:
+            out["free_queue"] = len(self._free_q)
+        with self._ring_lock:
+            out["ring_events"] = len(self._ring_events)
+            out["ring_events_dropped"] = self._ring_dropped
+        out["cluster_events"] = len(self._events)
+        return out
 
     def rpc_next_job_id(self) -> int:
         with self._lock:

@@ -203,6 +203,13 @@ def fire(site: str, **ctx: Any) -> Optional[str]:
         if not hit:
             continue
         _stats[site] = _stats.get(site, 0) + 1
+        try:
+            # Lazy import: fault_plane loads before the util package in
+            # some spawn paths, and a fired rule is far off any hot path.
+            from ray_tpu.util import events as _events
+            _events.emit("fault.fired", site, attrs={"action": r.action})
+        except Exception:
+            pass
         if r.action == "delay":
             time.sleep(r.delay_s)
         elif r.action == "raise":

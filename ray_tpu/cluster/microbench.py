@@ -203,6 +203,29 @@ def main(argv=None) -> int:
         per, _ = timed(task_roundtrip, min_time=2.0 * scale)
         results["task_roundtrip_per_sec"] = round(1 / per, 1)
 
+        # -- observability overhead (obs_overhead gate) ---------------
+        # The same roundtrip with tracing + the event ring on: the
+        # flight-recorder tax is ring appends and span buffering only
+        # (all shipping is async), so this must stay within tolerance
+        # of the plain rate under --compare. Also measured with the
+        # ring disabled, pinning the cost of the enabled()-check path.
+        from ray_tpu import config as _config
+        settle()
+        _config.set_override("tracing_enabled", True)
+
+        def task_roundtrip_traced():
+            ray_tpu.get(nop.remote())
+
+        per, _ = timed(task_roundtrip_traced, min_time=2.0 * scale)
+        results["task_roundtrip_traced_per_sec"] = round(1 / per, 1)
+        _config.clear_override("tracing_enabled")
+
+        settle()
+        _config.set_override("events_enabled", False)
+        per, _ = timed(task_roundtrip, min_time=2.0 * scale)
+        results["task_roundtrip_events_off_per_sec"] = round(1 / per, 1)
+        _config.clear_override("events_enabled")
+
         # -- inline-return roundtrip (reply-carried 1KiB payload) -----
         # Exercises the execution-plane fast path end to end: the result
         # rides the push reply, the caller's get() is served from the

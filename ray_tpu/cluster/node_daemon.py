@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu import config
 from ray_tpu.cluster import fault_plane, object_client
 from ray_tpu.cluster.protocol import RpcServer, get_client
+from ray_tpu.util import events as _events
 
 CHUNK_SIZE = 8 << 20  # object transfer chunk (reference uses 5MiB chunks)
 
@@ -243,6 +244,12 @@ class NodeDaemon:
             resources=self.total_resources, store_socket=self.store_socket,
             is_head=is_head, tpu_slice=self.tpu_slice)
         self._conductor_epoch = (reg or {}).get("epoch")
+        # Flight recorder: the daemon ships its ring delta piggybacked on
+        # the heartbeat (no second periodic conductor connection). In head
+        # mode the driver's _finish_init upgrades this same process with a
+        # background flusher.
+        _events.configure(self.node_id, conductor_address,
+                          start_flusher=False)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True, name="daemon-hb")
         self._hb_thread.start()
@@ -328,7 +335,8 @@ class NodeDaemon:
             try:
                 resp = cli.call("heartbeat", node_id=self.node_id,
                                 resources_available=avail,
-                                pending_demand=demand)
+                                pending_demand=demand,
+                                events=_events.heartbeat_payload())
             except Exception:
                 time.sleep(0.5)
                 continue
@@ -754,6 +762,15 @@ class NodeDaemon:
                                 pass
             for w in dead:
                 exit_code = w.proc.returncode
+                # Reap the dead worker's metrics snapshot: its KV entry is
+                # keyed (node, pid) and nothing will ever refresh it again
+                # (stale snapshots otherwise pollute /metrics forever).
+                try:
+                    get_client(self.conductor_address).call(
+                        "kv_del", ns="metrics",
+                        key=f"proc-{self.node_id.hex()}-{w.pid}".encode())
+                except Exception:
+                    pass
                 if w.lease_id is not None:
                     self._release_lease_resources(w)
                 if w.actor_id is not None:
@@ -1531,6 +1548,42 @@ class NodeDaemon:
 
     def rpc_ping(self) -> str:
         return "pong"
+
+    def rpc_debug_state(self) -> dict:
+        """Structured debug-state dump (raylet debug_state.txt role: the
+        node manager's table sizes, pools, budgets — machine-readable)."""
+        with self._lock:
+            state = {
+                "role": "daemon",
+                "node_id": self.node_id.hex(),
+                "pid": os.getpid(),
+                "is_head": self.is_head,
+                "resources_total": dict(self.total_resources),
+                "resources_available": dict(self._avail),
+                "workers": len(self._workers),
+                "worker_pids": sorted(
+                    w.pid for w in self._workers.values())[:128],
+                "idle_workers": {k: len(q)
+                                 for k, q in self._idle.items() if q},
+                "leases": len(self._leases),
+                "bundles": len(self._bundles),
+                "pending_demand": len(self._pending_demand),
+                "pending_death_reports": len(self._pending_death_reports),
+                "prestarting": self._prestarting,
+                "jobs": len(self._jobs),
+            }
+        with self._push_lock:
+            state["push_partial"] = len(self._push_partial)
+        with self._serve_lock:
+            state["serve_views"] = len(self._serve_views)
+            state["serving_chunks"] = self._serving_chunks
+            state["served_chunks"] = self._served_chunks
+            state["remote_pins"] = len(self._remote_pins)
+        try:
+            state["store"] = self.store.stats()
+        except Exception:
+            pass
+        return state
 
     def rpc_profile_worker(self, pid: int, duration_s: float = 1.0,
                            interval_s: float = 0.01) -> Optional[str]:

@@ -27,6 +27,7 @@ from ray_tpu.cluster.protocol import ConnectionLost, RpcError, get_client
 from ray_tpu.core import serialization
 from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
 from ray_tpu.core.ids import ObjectID, store_key
+from ray_tpu.util import events as _events
 
 # Batch-get miss marker (a stored value may legitimately be None).
 MISS = object()
@@ -462,6 +463,12 @@ class ObjectPlane:
                 out[i] = serialization.deserialize(memoryview(blob))
             else:
                 need.append(i)
+        if _events.enabled():
+            hits = len(keys) - len(need)
+            if hits:
+                _events.emit("inline.hit", value=float(hits))
+            if need:
+                _events.emit("inline.miss", value=float(len(need)))
         if need:
             blobs = self.store.get_inline_batch(
                 [keys[i] for i in need], max_bytes=self._inline_max())
@@ -476,7 +483,9 @@ class ObjectPlane:
         # store/conductor round trips.
         blob = self._inline.get(key)
         if blob is not None:
+            _events.emit("inline.hit")
             return serialization.deserialize(memoryview(blob))
+        _events.emit("inline.miss")
         # Small sealed LOCAL objects come back inline in ONE store round
         # trip (no get+release pair, no mmap) — the dominant pattern when
         # ray_tpu.get() collects many small task results.
@@ -584,6 +593,9 @@ class ObjectPlane:
         with lock:
             if self.store.contains(key):
                 return "ok"
+            _events.emit("pull.window", key.hex(), value=float(len(nodes)))
+            watch = _events.watch_begin("pull", key.hex())
+            t_pull = time.monotonic()
             admitted = 0
             created = False
             try:
@@ -625,7 +637,10 @@ class ObjectPlane:
             finally:
                 if admitted:
                     self._pull_budget.release(admitted)
+                _events.watch_end(watch)
             self._loc_batcher.add(key)
+            _events.emit("pull.done", key.hex(),
+                         value=time.monotonic() - t_pull)
             return "ok"
 
     def _probe_holders(self, key: bytes, nodes: List[dict]):
@@ -715,6 +730,9 @@ class ObjectPlane:
                 finally:
                     mv.release()
                     mm.close()
+                _events.emit("pull.shm_direct", key.hex(),
+                             value=float(size),
+                             attrs={"holder": node["address"]})
                 return True
             except Exception:  # noqa: BLE001 - fall back to chunked pull
                 continue
@@ -741,6 +759,8 @@ class ObjectPlane:
         from ray_tpu import config
         if size == 0:
             return "ok"
+        ring = _events.enabled()
+        key_hex = key.hex()
         chunk_bytes = max(1, int(config.get("object_transfer_chunk_bytes")))
         window = max(1, int(config.get("object_pull_window")))
         live = {i: src for i, src in enumerate(sources)}
@@ -761,6 +781,9 @@ class ObjectPlane:
                 any_missing = True  # holder dropped the object mid-pull
             self._drop_location(key, node["node_id"])
             orphans = pending.pop(i, deque())
+            _events.emit("pull.failover", key.hex(),
+                         value=float(len(orphans)),
+                         attrs={"holder": node["address"]})
             if live:
                 order = list(live)
                 for j, off in enumerate(orphans):
@@ -816,6 +839,9 @@ class ObjectPlane:
                         pending[order[0]].append(off)
                     continue
                 w.write_at(off, chunk)
+                if ring:
+                    _events.emit("pull.chunk", key_hex,
+                                 value=float(len(chunk)))
                 remaining -= 1
         if remaining:
             if any_unreachable:
@@ -843,6 +869,56 @@ class ObjectPlane:
 
     def free(self, oid: ObjectID) -> None:
         self.conductor.call("free_object", oid=self._key(oid))
+
+    # -- introspection ---------------------------------------------------
+    def metrics_probe(self) -> Dict[str, float]:
+        """Point-in-time gauges for the event flusher (registered via
+        events.register_probe — sampled once per flush period, never on
+        the put/get hot path)."""
+        inline = self._inline
+        with inline._cv:
+            cache_entries = len(inline._blobs)
+            cache_bytes = inline._nbytes
+            pending = len(inline._pending)
+        budget = self._pull_budget
+        with budget._cv:
+            pull_used = budget._used
+            pull_waiters = len(budget._queue)
+        with self._loc_batcher._lock:
+            loc_backlog = len(self._loc_batcher._buf)
+        return {
+            "rt_inline_cache_entries": float(cache_entries),
+            "rt_inline_cache_bytes": float(cache_bytes),
+            "rt_inline_pending_returns": float(pending),
+            "rt_pull_inflight_bytes": float(pull_used),
+            "rt_pull_budget_waiters": float(pull_waiters),
+            "rt_location_batch_backlog": float(loc_backlog),
+        }
+
+    def debug_state(self) -> dict:
+        """Table sizes + budgets for debug-state dumps (the ObjectManager
+        / PullManager sections of raylet's debug_state.txt)."""
+        inline = self._inline
+        with inline._cv:
+            inline_state = {
+                "cache_entries": len(inline._blobs),
+                "cache_bytes": inline._nbytes,
+                "cache_max_bytes": inline.max_bytes,
+                "pending_returns": len(inline._pending),
+            }
+        budget = self._pull_budget
+        with budget._cv:
+            pull_state = {"budget_cap": budget.cap,
+                          "budget_used": budget._used,
+                          "budget_waiters": len(budget._queue),
+                          "locks": len(self._pull_locks)}
+        with self._loc_batcher._lock:
+            batcher_state = {
+                "backlog": len(self._loc_batcher._buf),
+                "dropped_total": self._loc_batcher.dropped_total,
+            }
+        return {"inline_cache": inline_state, "pulls": pull_state,
+                "location_batcher": batcher_state}
 
     def stop(self) -> None:
         self._loc_batcher.stop()

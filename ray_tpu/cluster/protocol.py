@@ -44,10 +44,47 @@ import struct
 import tempfile
 import threading
 import time
+import weakref
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.cluster import fault_plane
+from ray_tpu.util import events as _events
+
+# Live pipelined channels, for the rt_rpc_inflight gauge and the slow-op
+# watchdog's in-flight frame scan (both sampled by the event flusher —
+# never on the request path).
+_pipe_channels: "weakref.WeakSet" = weakref.WeakSet()
+
+# rpc.frame ring events aggregate this many frames per event (a slow
+# frame flushes the aggregate immediately) — per-frame emission at
+# task-fast-path rates would dominate the flusher's fold/ship budget.
+_FRAME_AGG = 16
+
+
+def _rpc_inflight_probe() -> Dict[str, float]:
+    n = 0
+    for ch in list(_pipe_channels):
+        n += len(ch._pending)
+    return {"rt_rpc_inflight": float(n),
+            "rt_rpc_channels": float(len(_pipe_channels))}
+
+
+def _rpc_inflight_scan() -> List[tuple]:
+    """(kind, ident, elapsed_s) for every in-flight pipelined frame — the
+    watchdog's view of stuck RPCs, read from the channels' meta sidecars
+    so the request path pays no watchdog registration."""
+    out = []
+    now = time.monotonic()
+    for ch in list(_pipe_channels):
+        with ch._lock:
+            metas = list(ch._meta.values())
+        out.extend(("rpc", m[2], now - m[0]) for m in metas)
+    return out
+
+
+_events.register_probe("rpc", _rpc_inflight_probe)
+_events.register_inflight_scan("rpc", _rpc_inflight_scan)
 
 
 def _uds_path(port: int) -> str:
@@ -410,8 +447,21 @@ class _PipeChannel:
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
+        # Flight-recorder sidecar: seq -> (t_send, bytes, method). Only
+        # populated while events are enabled; popped with the matching
+        # future so it can never grow past _pending. The slow-op watchdog
+        # reads it via _rpc_inflight_scan, so frames need no per-call
+        # watchdog registration.
+        self._meta: Dict[int, tuple] = {}
+        # Reader-thread-only rpc.frame aggregation [frames, bytes]: one
+        # ring event per _FRAME_AGG frames (or any slow frame) keeps the
+        # per-frame hot-path cost to two dict ops.
+        self._agg = [0, 0]
+        self._transport = ("uds" if sock.family == socket.AF_UNIX
+                           else "tcp")
         self._seq = itertools.count()
         self.dead: Optional[BaseException] = None
+        _pipe_channels.add(self)
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name="rpc-pipe-reader")
         self._reader.start()
@@ -419,11 +469,18 @@ class _PipeChannel:
     def request(self, method: str, kwargs: dict) -> Future:
         fut: Future = Future()
         seq = next(self._seq)
+        parts = _dumps_parts((seq, method, kwargs))
+        record = _events.enabled()
+        nbytes = sum(memoryview(p).nbytes for p in parts) if record else 0
         with self._lock:
             if self.dead is not None:
                 fut.set_exception(ConnectionLost(str(self.dead)))
                 return fut
             self._pending[seq] = fut
+            if record:
+                # Before the send: the reply (and the reader popping the
+                # meta) can only race a meta recorded after it.
+                self._meta[seq] = (time.monotonic(), nbytes, method)
         try:
             # Fault point: client-side loss on the pipelined channel. sever
             # closes the shared socket, so the send below (or the reader
@@ -432,12 +489,12 @@ class _PipeChannel:
             if fault_plane.fire("rpc.client.send", method=method,
                                 pipelined=True) == "sever":
                 self._sock.close()
-            parts = _dumps_parts((seq, method, kwargs))
             with self._send_lock:
                 _send_parts(self._sock, parts)
         except BaseException as e:  # noqa: BLE001
             with self._lock:
                 self._pending.pop(seq, None)
+                self._meta.pop(seq, None)
             self._fail_all(e)
             if not fut.done():
                 fut.set_exception(ConnectionLost(repr(e)))
@@ -452,6 +509,21 @@ class _PipeChannel:
                 return
             with self._lock:
                 fut = self._pending.pop(seq, None)
+                meta = self._meta.pop(seq, None)
+            if meta is not None:
+                # Aggregated frame accounting (reader-thread-only state):
+                # a ring event per _FRAME_AGG frames — or immediately for
+                # a slow frame — carries the batch's frame/byte totals and
+                # the triggering frame's latency as the sample.
+                agg = self._agg
+                agg[0] += 1
+                agg[1] += meta[1]
+                lat = time.monotonic() - meta[0]
+                if agg[0] >= _FRAME_AGG or lat >= 0.01:
+                    _events.emit("rpc.frame", meta[2], value=lat,
+                                 attrs={"frames": agg[0], "bytes": agg[1],
+                                        "transport": self._transport})
+                    agg[0] = agg[1] = 0
             if fut is None:
                 continue
             if ok:
@@ -466,6 +538,7 @@ class _PipeChannel:
             if self.dead is None:
                 self.dead = exc
             pending, self._pending = self._pending, {}
+            self._meta = {}
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost(repr(exc)))

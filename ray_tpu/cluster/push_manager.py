@@ -24,6 +24,7 @@ from typing import Dict, Tuple
 
 from ray_tpu.cluster import fault_plane
 from ray_tpu.cluster.protocol import get_client
+from ray_tpu.util import events as _events
 
 PUSH_CHUNK = 1 << 20          # bytes per push_chunk RPC
 _RECENT_TTL_S = 30.0          # don't re-push same (oid, target) within this
@@ -108,6 +109,8 @@ class PushManager:
                                            offset=off, target=target)
                     if act == "sever":
                         cli.sever_pipe()
+                    _events.emit("push.chunk", key.hex(), value=float(n),
+                                 attrs={"target": target})
                     futs.append(cli.call_async(
                         "push_chunk", oid=key, offset=off, total=size,
                         chunk=pickle.PickleBuffer(view[off:off + n]),

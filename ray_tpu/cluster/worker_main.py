@@ -32,6 +32,7 @@ from ray_tpu.core import refs as _refs_mod
 from ray_tpu.core.exceptions import (GetTimeoutError, ObjectLostError,
                                      TaskError)
 from ray_tpu.core.ids import ObjectID, TaskID, WorkerID, store_key
+from ray_tpu.util import events as _events
 
 
 class _LazySealer:
@@ -95,6 +96,8 @@ class _LazySealer:
                         self.plane.put_blob(oid, blob)
                     except Exception:
                         pass
+            if batch:
+                _events.emit("inline.seal", value=float(len(batch)))
 
 
 class TaskEventLog:
@@ -396,6 +399,9 @@ class WorkerService:
                     collect[:] = []
         end = time.time()
         self.events.record(task_id, name, "task", start, end, error)
+        _events.emit("task.exec", task_id.hex(), value=end - start,
+                     attrs={"task": name, "error": error} if error
+                     else {"task": name})
         if trace_ctx is not None:
             from ray_tpu.util import tracing
             ctx = tracing.new_context(parent=trace_ctx)
@@ -433,12 +439,9 @@ class WorkerService:
                 returns[t["task_id"]] = entries
         self._flush_refs()
         self._queue_seals(returns.values())
-        if any("trace_ctx" in t for t in tasks):
-            from ray_tpu import config
-            from ray_tpu.util import tracing
-            tracing.flush(get_client(
-                self.conductor_address,
-                reconnect_s=config.get("gcs_rpc_reconnect_s")))
+        # Traced spans ship via the background event flusher (events.py) —
+        # the old synchronous tracing.flush here put a conductor RPC on
+        # every traced batch ack.
         return {"ok": True, "node_id": self.node_id, "returns": returns}
 
     def rpc_cancel_task(self, task_id: bytes) -> None:
@@ -723,6 +726,36 @@ class WorkerService:
 
     def rpc_ping(self) -> str:
         return "pong"
+
+    def rpc_debug_state(self) -> dict:
+        """Structured debug-state dump (the worker's share of raylet
+        debug_state.txt: execution queues, actor tenancy, seal backlog)."""
+        with self._seq_lock:
+            active = self._active_calls
+            taken_pins = len(self._taken_pins)
+            ordered_callers = len(self._next_seq)
+            actor_id = self.actor_id
+        with self._sealer._cv:
+            seal_backlog = len(self._sealer._q)
+        return {
+            "role": "worker",
+            "worker_id": self.worker_id.binary().hex(),
+            "node_id": self.node_id.hex(),
+            "pid": os.getpid(),
+            "actor": {
+                "actor_id": actor_id.hex() if actor_id else None,
+                "class_name": self.actor_class_name,
+                "is_async": self.actor_is_async,
+                "max_concurrency": self.actor_max_concurrency,
+                "active_calls": active,
+                "ordered_callers": ordered_callers,
+                "taken_pins": taken_pins,
+            },
+            "cancelled_pending": len(self._cancelled),
+            "fn_cache_entries": len(self._fn_cache),
+            "lazy_seal_backlog": seal_backlog,
+            "object_plane": self.plane.debug_state(),
+        }
 
     def rpc_profile(self, duration_s: float = 1.0,
                     interval_s: float = 0.01) -> str:

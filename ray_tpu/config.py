@@ -261,3 +261,18 @@ define("tracing_enabled", bool, False,
        "Record OTel-style spans around task submit/execute "
        "(util/tracing.py; read via state.list_spans).")
 define("metrics_export_period_s", float, 5.0, "Metrics flush period.")
+define("events_enabled", bool, True,
+       "Flight-recorder event ring (util/events.py): per-process "
+       "lifecycle events across all planes, shipped to the conductor in "
+       "background batches. Always-on by design — the hot-path cost is "
+       "one cached flag check plus a ring-slot store.")
+define("event_ring_size", int, 16384,
+       "Flight-recorder ring capacity per process; overwrites oldest "
+       "(dropped counts ship with the next batch).")
+define("event_flush_period_s", float, 0.5,
+       "Background flush period for the event ring (and buffered "
+       "tracing spans) to the conductor.")
+define("slow_op_threshold_s", float, 30.0,
+       "Slow-op watchdog: a task/pull/RPC in flight longer than this "
+       "emits a SLOW_OPERATION cluster event carrying the surrounding "
+       "ring context. 0 disables.")

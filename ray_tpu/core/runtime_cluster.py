@@ -43,6 +43,7 @@ from ray_tpu.core.options import ActorOptions, TaskOptions
 from ray_tpu.core.refs import ObjectRef
 from ray_tpu.core.task_spec import FunctionDescriptor, top_level_ref_args
 from ray_tpu.runtime_env import env_fingerprint as _env_fingerprint
+from ray_tpu.util import events as _events
 
 _LEASE_LINGER_S = 0.25     # idle lease kept briefly for reuse
 _MAX_LEASES_PER_KEY = 64
@@ -73,7 +74,7 @@ class _KeyState:
 
 class _TaskRecord:
     __slots__ = ("task", "retries_left", "done", "cancelled", "submitted_at",
-                 "solo")
+                 "solo", "watch")
 
     def __init__(self, task: dict, retries_left: int):
         self.task = task
@@ -85,6 +86,8 @@ class _TaskRecord:
         # poison task alone is charged a retry on its next (solo) failure,
         # and healthy batch-mates stop being re-coalesced with it.
         self.solo = False
+        # slow-op watchdog token: closed on ack or terminal failure
+        self.watch = _events.watch_begin("task", task["task_id"].hex())
 
     def nbytes(self) -> int:
         n = len(self.task.get("args_blob") or b"")
@@ -386,6 +389,8 @@ class TaskSubmitter:
         """Release in-flight argument pins exactly once (after the first
         successful execution ack, or on terminal failure). dict.pop makes
         the release atomic against a cancel()/completion race."""
+        _events.watch_end(rec.watch)   # task reached a terminal state
+        rec.watch = None
         self.rt._unpin_task(rec.task)
 
     def _run_on(self, st: _KeyState, w: _LeasedWorker,
@@ -439,8 +444,12 @@ class TaskSubmitter:
             return
         returns = (resp or {}).get("returns") or {}
         node_id = (resp or {}).get("node_id")
+        ring = _events.enabled()
         for rec in recs:
             rec.done = True
+            if ring:
+                _events.emit("task.reply", rec.task["task_id"].hex(),
+                             value=time.monotonic() - rec.submitted_at)
             self.rt._seed_returns(rec.task,
                                   returns.get(rec.task["task_id"]), node_id)
             self._unpin_args(rec)
@@ -471,6 +480,7 @@ class TaskSubmitter:
                 if len(recs) == 1 and rec.retries_left > 0:
                     rec.retries_left -= 1
                 rec.solo = True
+                _events.emit("task.retry", rec.task["task_id"].hex())
                 self._enqueue(rec)
 
         if retriable:
@@ -775,6 +785,8 @@ class _ActorClient:
             cli = get_client(self.address)
             base = self.seqno
             futs = []
+            _events.emit("actor.window", self.actor_id.hex()[:16],
+                         value=len(batch))
             try:
                 for i, task in enumerate(batch):
                     f = cli.call_async(
@@ -962,6 +974,12 @@ class ClusterRuntime:
         # ref before the producer's lazy seal lands.
         self._ref_tracker.on_zero = self.plane.drop_inline
         _refs_mod._tracker = self._ref_tracker
+        # Flight recorder: bind this process's event ring to the cluster
+        # and start the background flusher — from here on ring deltas AND
+        # buffered tracing spans ship asynchronously (nothing on the
+        # submit/execute path performs a synchronous conductor RPC).
+        _events.configure(self.node_id, self.conductor_address)
+        _events.register_probe("object_plane", self.plane.metrics_probe)
         # inline-arg flag cache (config.get walks os.environ; hot path)
         self._iargs_gen = None
         self._iargs_on = True
@@ -1057,6 +1075,7 @@ class ClusterRuntime:
                 key=lambda n: -sum(n["resources_available"].get(k, 0.0)
                                    for k in ("CPU", "TPU")))
             targets += [n["address"] for n in nodes]
+        t0 = time.monotonic()
         for addr in targets:
             try:
                 # _timeout bounds the client read: a daemon stuck spawning
@@ -1078,6 +1097,8 @@ class ClusterRuntime:
                 continue
             if resp.get("granted"):
                 grants = resp.get("leases") or [resp]
+                _events.emit("lease.grant", value=time.monotonic() - t0,
+                             attrs={"count": len(grants)})
                 return [_LeasedWorker(g["lease_id"], g["worker_address"],
                                       addr) for g in grants]
             if resp.get("env_error"):
@@ -1424,18 +1445,22 @@ class ClusterRuntime:
         # Returns may arrive IN the push reply: getters park on the reply
         # instead of polling the store/directory.
         self.plane.add_pending([store_key(r.binary()) for r in rets])
+        _events.emit("task.submit", task_id.hex(),
+                     attrs={"task": task["name"]})
         from ray_tpu.util import tracing
         if tracing.enabled():
             # Submit span (instant) + context propagated in the spec so
             # the worker's execute span joins the same trace
-            # (tracing_helper.py role).
+            # (tracing_helper.py role). Spans buffer locally and ship via
+            # the flight recorder's background flusher — the synchronous
+            # tracing.flush that used to sit here put a conductor round
+            # trip on EVERY submission and halved the task fast path.
             ctx = tracing.new_context()
-            now = __import__("time").time()
+            now = time.time()
             tracing.record("task.submit", now, now, ctx,
                            {"task": task["name"],
                             "task_id": task_id.hex()})
             task["trace_ctx"] = ctx
-            tracing.flush(self.conductor)
         # Return refs are constructed BEFORE the push: the reply can beat
         # this function's tail (inline dispatch + a fast worker), and
         # _seed_returns only caches blobs while tracker.holds() — a ref
@@ -1736,13 +1761,87 @@ class ClusterRuntime:
         return self.conductor.call("available_resources")
 
     def timeline_events(self) -> List[dict]:
-        raw = self.conductor.call("get_task_events")
-        return [{
-            "cat": e["kind"], "name": e["name"], "ph": "X",
-            "ts": e["start"] * 1e6, "dur": (e["end"] - e["start"]) * 1e6,
-            "pid": e["node_id"][:8], "tid": e["pid"],
-            "args": {"error": e["error"]},
-        } for e in raw]
+        """Merged cluster-wide Chrome-trace events (ray.timeline parity):
+        execution X slices from the task-event store, submit/reply instants
+        from the flight-recorder ring, flow events ("s"/"t"/"f", joined on
+        the task id) linking submit -> execute -> reply across processes,
+        and an object-transfer view from the pull/push ring events. Every
+        event carries ts + dur (flow/instant events use dur 0)."""
+        try:
+            _events.flush_now()   # this process's tail rides along
+        except Exception:
+            pass
+        out: List[dict] = []
+        exec_ts: Dict[str, float] = {}
+        for e in self.conductor.call("get_task_events"):
+            tid = e.get("task_id", "")
+            out.append({
+                "cat": e["kind"], "name": e["name"], "ph": "X",
+                "ts": e["start"] * 1e6,
+                "dur": (e["end"] - e["start"]) * 1e6,
+                "pid": e["node_id"][:8], "tid": e["pid"],
+                "args": {"error": e["error"], "task_id": tid},
+            })
+            if e["kind"] == "task" and tid:
+                # flow step at execution start, bound by task id
+                out.append({"cat": "task_flow", "name": "task", "ph": "t",
+                            "id": tid, "ts": e["start"] * 1e6, "dur": 0,
+                            "bp": "e", "pid": e["node_id"][:8],
+                            "tid": e["pid"]})
+                exec_ts[tid] = e["start"]
+        try:
+            ring = self.conductor.call("get_ring_events")
+        except Exception:
+            ring = []
+        for e in ring:
+            kind, ident = e["kind"], e["ident"]
+            pid_, tid_ = e["node_id"][:8], e["pid"]
+            ts_us = e["ts"] * 1e6
+            if kind == "task.submit" and ident:
+                out.append({"cat": "task", "name": "task.submit", "ph": "X",
+                            "ts": ts_us, "dur": 0, "pid": pid_, "tid": tid_,
+                            "args": {"task_id": ident,
+                                     **(e["attrs"] or {})}})
+                out.append({"cat": "task_flow", "name": "task", "ph": "s",
+                            "id": ident, "ts": ts_us, "dur": 0,
+                            "pid": pid_, "tid": tid_})
+            elif kind == "task.reply" and ident:
+                out.append({"cat": "task", "name": "task.reply", "ph": "X",
+                            "ts": ts_us, "dur": 0, "pid": pid_, "tid": tid_,
+                            "args": {"task_id": ident,
+                                     "roundtrip_s": e["value"]}})
+                out.append({"cat": "task_flow", "name": "task", "ph": "f",
+                            "bp": "e", "id": ident, "ts": ts_us, "dur": 0,
+                            "pid": pid_, "tid": tid_})
+            elif kind.startswith(("pull.", "push.")):
+                # object-transfer view (ray.timeline's transfer rows)
+                dur = e["value"] * 1e6 if kind == "pull.done" else 0
+                out.append({"cat": "object_transfer", "name": kind,
+                            "ph": "X", "ts": ts_us - dur, "dur": dur,
+                            "pid": pid_, "tid": tid_,
+                            "args": {"object_id": ident, "value": e["value"],
+                                     **(e["attrs"] or {})}})
+        return out
+
+    def debug_state(self) -> dict:
+        """Driver-side slice of the cluster debug dump (the conductor and
+        daemons add theirs via state.debug_state)."""
+        sub = self.submitter
+        with sub._lineage_lock:
+            lineage = len(sub._lineage)
+            lineage_bytes = sub._lineage_bytes
+        with sub._lock:
+            key_states = len(sub._keys)
+        return {
+            "role": "driver",
+            "node_id": self.node_id.hex(),
+            "lineage_records": lineage,
+            "lineage_bytes": lineage_bytes,
+            "scheduling_keys": key_states,
+            "tasks_waiting_deps": len(sub._waiting),
+            "actor_clients": len(self._actor_clients),
+            "object_plane": self.plane.debug_state(),
+        }
 
     def list_actors(self) -> List[dict]:
         return self.conductor.call("list_actors")
@@ -1752,6 +1851,10 @@ class ClusterRuntime:
         try:
             self._log_stop.set()
         except AttributeError:
+            pass
+        try:
+            _events.stop()   # final async flush; flusher thread retires
+        except Exception:
             pass
         try:
             self._flush_registrations(timeout=5.0)

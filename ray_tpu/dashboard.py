@@ -43,18 +43,25 @@ th{background:#f0f0f0} .ALIVE{color:#0a7d32} .DEAD,.FAILED{color:#b00020}
 <script>
 async function j(p){return (await fetch(p)).json()}
 (async()=>{
- const [cl,no,ac,jo]=await Promise.all(
-   [j('/api/cluster'),j('/api/nodes'),j('/api/actors'),j('/api/jobs')]);
+ const [cl,no,ac,jo,dbg]=await Promise.all(
+   [j('/api/cluster'),j('/api/nodes'),j('/api/actors'),j('/api/jobs'),
+    j('/api/debug').catch(()=>({nodes:{}}))]);
  let h=`<h2>Resources</h2><table><tr><th>resource</th><th>available</th>
  <th>total</th></tr>`;
  for(const k of Object.keys(cl.total))
    h+=`<tr><td>${k}</td><td>${cl.available[k]??0}</td>
    <td>${cl.total[k]}</td></tr>`;
  h+=`</table><h2>Nodes (${no.length})</h2><table><tr><th>node</th>
- <th>state</th><th>head</th><th>address</th><th>resources</th></tr>`;
- for(const n of no) h+=`<tr><td>${n.node_id.slice(0,12)}</td>
+ <th>state</th><th>head</th><th>address</th><th>resources</th>
+ <th>debug</th><th>workers (profile)</th></tr>`;
+ for(const n of no){
+   const d=(dbg.nodes||{})[n.node_id]||{};
+   const pids=(d.worker_pids||[]).map(p=>
+     `<a href=/api/profile/${n.node_id}/${p}?duration=2>${p}</a>`).join(' ');
+   h+=`<tr><td>${n.node_id.slice(0,12)}</td>
  <td class=${n.state}>${n.state}</td><td>${n.is_head_node?'✓':''}</td>
- <td>${n.address}</td><td>${JSON.stringify(n.resources_total)}</td></tr>`;
+ <td>${n.address}</td><td>${JSON.stringify(n.resources_total)}</td>
+ <td><a href=/api/debug/${n.node_id}>state</a></td><td>${pids}</td></tr>`;}
  h+=`</table><h2>Actors (${ac.length})</h2><table><tr><th>actor</th>
  <th>class</th><th>name</th><th>state</th><th>restarts</th></tr>`;
  for(const a of ac) h+=`<tr><td>${a.actor_id.slice(0,12)}</td>
@@ -108,13 +115,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(dash.events())
             elif path == "/api/spans":
                 self._json(dash.spans())
+            elif path == "/api/ring":
+                self._json(dash.ring())
+            elif path == "/api/debug":
+                self._json(dash.debug())
+            elif path.startswith("/api/debug/"):
+                # /api/debug/<node_hex> -> that node's daemon debug_state
+                self._json(dash.debug(path.rsplit("/", 1)[-1]))
             elif path.startswith("/api/profile/"):
-                # /api/profile/<pid>?duration=2 -> collapsed stacks
+                # /api/profile/<pid>?duration=2            (any node)
+                # /api/profile/<node_hex>/<pid>?duration=2 (scoped)
                 from urllib.parse import parse_qs, urlparse
                 q = parse_qs(urlparse(self.path).query)
                 dur = float(q.get("duration", ["2.0"])[0])
-                self._send(dash.profile(int(path.rsplit("/", 1)[-1]),
-                                        dur).encode(), "text/plain")
+                seg = path[len("/api/profile/"):].split("/")
+                node_hex = seg[0] if len(seg) > 1 else None
+                self._send(dash.profile(int(seg[-1]), dur,
+                                        node_hex=node_hex).encode(),
+                           "text/plain")
             elif path == "/metrics":
                 from ray_tpu.util.metrics import prometheus_text
                 self._send(prometheus_text().encode(), "text/plain")
@@ -179,11 +197,26 @@ class Dashboard:
         return self._cli.call("list_events", limit=limit)
 
     def spans(self) -> list:
+        # Spans ship via the background event flusher; flush this
+        # process's tail first so a head-side dashboard read sees its
+        # own just-recorded spans (read-your-writes, timeline() parity).
+        try:
+            from ray_tpu.util import events as _events
+            _events.flush_now()
+        except Exception:
+            pass
         return self._cli.call("get_spans")
 
-    def profile(self, pid: int, duration_s: float = 2.0) -> str:
+    def profile(self, pid: int, duration_s: float = 2.0,
+                node_hex: Optional[str] = None) -> str:
+        """Collapsed-stack profile of the worker with this OS pid.
+        ``node_hex`` (a node-id hex prefix) scopes the probe to one node:
+        pids are per-host, so on a multi-host cluster an unscoped probe
+        can profile a DIFFERENT node's coincidentally-same pid."""
         for n in self._cli.call("get_nodes"):
             if not n["alive"]:
+                continue
+            if node_hex and not n["node_id"].hex().startswith(node_hex):
                 continue
             try:
                 dump = get_client(n["address"]).call(
@@ -193,7 +226,36 @@ class Dashboard:
                 continue
             if dump is not None:
                 return dump
-        return f"no live worker with pid {pid}"
+        where = f" on node {node_hex}" if node_hex else ""
+        return f"no live worker with pid {pid}{where}"
+
+    def ring(self, limit: int = 1000) -> list:
+        """Recent flight-recorder events (conductor ring store)."""
+        return self._cli.call("get_ring_events", limit=limit)
+
+    def debug(self, node_hex: Optional[str] = None) -> dict:
+        """Cluster debug-state dump (debug_state.txt role): conductor
+        tables plus per-node daemon tables; ``node_hex`` narrows to one
+        node's daemon."""
+        nodes = self._cli.call("get_nodes")
+        if node_hex:
+            for n in nodes:
+                if n["node_id"].hex().startswith(node_hex):
+                    if not n["alive"]:
+                        return {"error": f"node {node_hex} is dead"}
+                    return get_client(n["address"]).call("debug_state")
+            return {"error": f"no such node {node_hex}"}
+        out = {"conductor": self._cli.call("debug_state"), "nodes": {}}
+        for n in nodes:
+            if not n["alive"]:
+                continue
+            hexid = n["node_id"].hex()
+            try:
+                out["nodes"][hexid] = get_client(
+                    n["address"]).call("debug_state")
+            except Exception as e:  # noqa: BLE001 - per-node best effort
+                out["nodes"][hexid] = {"error": repr(e)}
+        return out
 
     def objects(self) -> list:
         out = []

@@ -147,6 +147,7 @@ def cmd_list(args) -> None:
           "nodes": state.list_nodes, "objects": state.list_objects,
           "placement-groups": state.list_placement_groups,
           "events": state.list_cluster_events,
+          "ring-events": state.list_ring_events,
           "spans": state.list_spans}[args.entity]
     print(json.dumps(fn(), indent=2, default=str))
 
@@ -156,7 +157,8 @@ def cmd_profile(args) -> None:
     worker (parity: `ray stack` / dashboard py-spy trigger)."""
     _connect(args)
     from ray_tpu import state
-    dump = state.profile_worker(args.pid, duration_s=args.duration)
+    dump = state.profile_worker(args.pid, duration_s=args.duration,
+                                node_id=args.node_id)
     if args.output:
         with open(args.output, "w") as f:
             f.write(dump)
@@ -183,6 +185,15 @@ def cmd_metrics(args) -> None:
     _connect(args)
     from ray_tpu.util.metrics import prometheus_text
     print(prometheus_text())
+
+
+def cmd_debug_state(args) -> None:
+    """`ray_tpu debug-state`: one JSON document with the conductor's and
+    every live daemon's internal table sizes (parity: the per-process
+    debug_state.txt files `ray status -v` points at)."""
+    _connect(args)
+    from ray_tpu import state
+    print(json.dumps(state.debug_state(), indent=2, default=str))
 
 
 def cmd_microbenchmark(args) -> None:
@@ -332,6 +343,7 @@ def main(argv=None) -> None:
 
     for name, fn in (("status", cmd_status), ("summary", cmd_summary),
                      ("timeline", cmd_timeline), ("metrics", cmd_metrics),
+                     ("debug-state", cmd_debug_state),
                      ("microbenchmark", cmd_microbenchmark)):
         p = sub.add_parser(name)
         p.add_argument("--address", default=None)
@@ -364,7 +376,7 @@ def main(argv=None) -> None:
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument("entity", choices=["actors", "tasks", "nodes", "objects",
                                       "placement-groups", "events",
-                                      "spans"])
+                                      "ring-events", "spans"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
 
@@ -372,6 +384,9 @@ def main(argv=None) -> None:
                        help="sample a worker's stacks (flamegraph input)")
     p.add_argument("pid", type=int)
     p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("--node-id", default=None,
+                   help="node-id hex prefix: scope the pid lookup to one "
+                        "node (pids are per-host)")
     p.add_argument("--output", "-o", default=None)
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_profile)

@@ -7,12 +7,13 @@ plus span listing (util/tracing) and on-demand worker profiling
 (util/profiler; the reporter module's py-spy role).
 """
 
-from ray_tpu.state.api import (list_actors, list_cluster_events,
-                               list_nodes, list_objects,
-                               list_placement_groups, list_spans,
-                               list_tasks, profile_worker,
-                               summarize_tasks)
+from ray_tpu.state.api import (debug_state, list_actors,
+                               list_cluster_events, list_nodes,
+                               list_objects, list_placement_groups,
+                               list_ring_events, list_spans, list_tasks,
+                               profile_worker, summarize_tasks)
 
 __all__ = ["list_actors", "list_tasks", "list_nodes", "list_objects",
            "list_placement_groups", "list_cluster_events", "list_spans",
-           "profile_worker", "summarize_tasks"]
+           "list_ring_events", "debug_state", "profile_worker",
+           "summarize_tasks"]

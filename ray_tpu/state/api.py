@@ -98,13 +98,17 @@ def list_spans(trace_id: Optional[str] = None) -> List[dict]:
 
 
 def profile_worker(pid: int, duration_s: float = 1.0,
-                   interval_s: float = 0.01) -> str:
+                   interval_s: float = 0.01,
+                   node_id: Optional[str] = None) -> str:
     """Sample a worker's Python stacks anywhere in the cluster ->
     collapsed-stack text (flamegraph.pl / speedscope input). Parity:
-    `ray stack` / the dashboard's py-spy trigger."""
+    `ray stack` / the dashboard's py-spy trigger. ``node_id`` (hex
+    prefix) scopes the probe to one node — pids are per-host."""
     from ray_tpu.cluster.protocol import get_client
     for n in _conductor().call("get_nodes"):
         if not n["alive"]:
+            continue
+        if node_id and not n["node_id"].hex().startswith(node_id):
             continue
         try:
             dump = get_client(n["address"]).call(
@@ -114,4 +118,32 @@ def profile_worker(pid: int, duration_s: float = 1.0,
             continue
         if dump is not None:
             return dump
-    raise ValueError(f"no live worker with pid {pid} in the cluster")
+    where = f" on node {node_id}" if node_id else " in the cluster"
+    raise ValueError(f"no live worker with pid {pid}{where}")
+
+
+def list_ring_events(limit: int = 0, kind: Optional[str] = None
+                     ) -> List[dict]:
+    """Flight-recorder events shipped to the conductor's ring store
+    (util/events.py). ``kind`` filters by exact kind or dotted prefix
+    ("pull" matches "pull.chunk"). Parity role: `ray list task-events`
+    over GcsTaskManager's buffered task events."""
+    return _conductor().call("get_ring_events", limit=limit, kind=kind)
+
+
+def debug_state() -> dict:
+    """Cluster-wide debug-state dump: the conductor's table sizes plus
+    every live node daemon's (raylet debug_state.txt parity, one JSON
+    document instead of per-node text files)."""
+    from ray_tpu.cluster.protocol import get_client
+    out = {"conductor": _conductor().call("debug_state"), "nodes": {}}
+    for n in _conductor().call("get_nodes"):
+        if not n["alive"]:
+            continue
+        hexid = n["node_id"].hex()
+        try:
+            out["nodes"][hexid] = get_client(
+                n["address"]).call("debug_state")
+        except Exception as e:  # noqa: BLE001 - per-node best effort
+            out["nodes"][hexid] = {"error": repr(e)}
+    return out

@@ -18,6 +18,35 @@ from typing import Dict, List, Optional, Tuple
 _registry: Dict[str, "Metric"] = {}
 _registry_lock = threading.Lock()
 _flusher_started = False
+_node_hex = ""   # set by events.configure; disambiguates the KV key
+
+
+def set_node(node_hex: str) -> None:
+    """Bind this process's metrics snapshots to a node identity. The KV
+    key must be unique per (node, pid): two workers on different nodes
+    can share an OS pid, and a bare ``proc-{pid}`` key made them
+    overwrite each other's snapshots."""
+    global _node_hex
+    _node_hex = node_hex
+
+
+def _kv_key() -> bytes:
+    return f"proc-{_node_hex}-{os.getpid()}".encode()
+
+
+_builtin_lock = threading.Lock()
+
+
+def builtin(cls, name: str, description: str = "", **kwargs) -> "Metric":
+    """Get-or-create a built-in runtime metric by name (the flight
+    recorder folds ring events into these off the hot path)."""
+    m = _registry.get(name)
+    if m is None:
+        with _builtin_lock:
+            m = _registry.get(name)
+            if m is None:
+                m = cls(name, description, **kwargs)
+    return m
 
 
 class Metric:
@@ -113,10 +142,12 @@ def _snapshot() -> dict:
                  "points": [(list(k), v) for k, v in m._points()]}
         if isinstance(m, Histogram):
             counts, sums = m._hist_points()
+            # Keep the tag tuples structured (not stringified): the
+            # exposition renderer needs them back as label pairs.
             entry["histogram"] = {
                 "boundaries": m.boundaries,
-                "counts": {str(list(k)): v for k, v in counts.items()},
-                "sums": {str(list(k)): v for k, v in sums.items()},
+                "series": [(list(k), v, sums.get(k, 0.0))
+                           for k, v in counts.items()],
             }
         out[m.name] = entry
     return out
@@ -132,8 +163,7 @@ def _flush_once() -> None:
         conductor = getattr(rt, "conductor", None)
         if conductor is None:
             return
-        conductor.call("kv_put", ns="metrics",
-                       key=f"proc-{os.getpid()}".encode(),
+        conductor.call("kv_put", ns="metrics", key=_kv_key(),
                        value=pickle.dumps(_snapshot(), protocol=5))
     except Exception:
         pass
@@ -174,6 +204,24 @@ def prometheus_text() -> str:
                 lines.append(f"# HELP {name} {entry['description']}")
                 lines.append(f"# TYPE {name} {entry['kind']}")
                 seen_help.add(name)
+            hist = entry.get("histogram")
+            if hist and "series" in hist:
+                # Proper histogram exposition: cumulative _bucket lines
+                # per le boundary (+Inf last), then _sum and _count —
+                # the last-observation gauge view is NOT rendered (one
+                # name must expose one type).
+                bounds = hist["boundaries"]
+                for tags, counts, total in hist["series"]:
+                    base = [f'{k}="{v}"' for k, v in tags]
+                    cum = 0
+                    for b, c in zip(list(bounds) + ["+Inf"], counts):
+                        cum += c
+                        label = ",".join(base + [f'le="{b}"'])
+                        lines.append(f'{name}_bucket{{{label}}} {cum}')
+                    label = "{" + ",".join(base) + "}" if base else ""
+                    lines.append(f"{name}_sum{label} {total}")
+                    lines.append(f"{name}_count{label} {cum}")
+                continue
             for tags, value in entry["points"]:
                 label = ",".join(f'{k}="{v}"' for k, v in tags)
                 label = "{" + label + "}" if label else ""
