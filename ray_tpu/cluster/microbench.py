@@ -295,6 +295,41 @@ def main(argv=None) -> int:
         per, _ = timed(actor_async, min_time=2.0 * scale, min_iters=2)
         results["actor_calls_async_per_sec"] = round(n_calls / per, 1)
 
+        # -- DAG roundtrips: classic lazy execute vs compiled graph ---
+        # Same 2-actor chain both ways. Classic pays two task submissions
+        # plus an owner-side get per execute; the compiled plan pays one
+        # input-channel write and one leaf-channel read (the resident
+        # loops never touch the scheduler).
+        settle()
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class Stage:
+            def step(self, x):
+                return x + 1
+
+        s1, s2 = Stage.bind(), Stage.bind()
+        with InputNode() as inp:
+            chain = s2.step.bind(s1.step.bind(inp))
+
+        def dag_classic():
+            assert chain.execute(1) == 3
+
+        per, _ = timed(dag_classic, min_time=2.0 * scale)
+        results["dag_classic_roundtrip_per_sec"] = round(1 / per, 1)
+
+        cg = chain.experimental_compile(max_in_flight=8)
+        assert ray_tpu.get(cg.execute(1), timeout=30) == 3  # warm
+
+        def compiled_graph():
+            assert ray_tpu.get(cg.execute(1), timeout=30) == 3
+
+        per, _ = timed(compiled_graph, min_time=2.0 * scale)
+        results["compiled_graph_roundtrip_per_sec"] = round(1 / per, 1)
+        cg.teardown()
+        for s in (s1, s2):
+            ray_tpu.kill(s._actor_handle)
+
         # -- actor creation throughput (zygote fork path) -------------
         # End-to-end: N actors created, first method call acked, killed.
         # Fractional CPUs so the 4-CPU cluster holds the whole cohort.

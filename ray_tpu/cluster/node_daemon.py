@@ -222,6 +222,10 @@ class NodeDaemon:
         # In-progress sender-initiated pushes (push_manager.h receive side).
         self._push_partial: Dict[bytes, dict] = {}
         self._push_lock = threading.Lock()
+        # Compiled-graph channel forwarder: attached shm writers for rings
+        # whose reader lives on this node (rpc_channel_write).
+        self._chan_writers: Dict[bytes, Any] = {}
+        self._chan_lock = threading.Lock()
         # Chunk-serve load counters, piggybacked on object_info so pullers
         # spread a broadcast across the least-loaded holders.
         self._serve_lock = threading.Lock()
@@ -1365,6 +1369,42 @@ class NodeDaemon:
         except Exception:
             pass  # location registration is best-effort; pulls re-register
         return {"done": True}
+
+    # -- compiled-graph channel forwarder (dag/channel.py) ---------------
+
+    def rpc_channel_write(self, chan_id: bytes, seq: int, data,
+                          flags: int = 0,
+                          timeout: Optional[float] = None) -> dict:
+        """Forward a cross-host compiled-graph slot write into the local
+        shm ring (the channel's reader lives on this node). Blocking is
+        fine here: classic frames dispatch on the executor pool, and the
+        ring itself provides the backpressure (a full ring means the
+        consumer is max_in_flight behind)."""
+        from ray_tpu.dag.channel import ChannelError, ShmChannelWriter
+        with self._chan_lock:
+            w = self._chan_writers.get(chan_id)
+        if w is None:
+            try:
+                w = ShmChannelWriter(self.store, chan_id)
+            except ChannelError as e:
+                return {"ok": False, "error": str(e)}
+            with self._chan_lock:
+                w = self._chan_writers.setdefault(chan_id, w)
+        try:
+            w.write(seq, data, int(flags), timeout=timeout)
+        except ChannelError as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": True}
+
+    def rpc_channel_close(self, chan_id: bytes) -> dict:
+        with self._chan_lock:
+            w = self._chan_writers.pop(chan_id, None)
+        if w is not None:
+            try:
+                w.close()
+            except Exception:
+                pass
+        return {"ok": True}
 
     def rpc_delete_object(self, oid: bytes) -> None:
         try:

@@ -126,6 +126,19 @@ def _send_frame(sock: socket.socket, payload: bytes) -> None:
 _OOB_MIN_BYTES = 256 * 1024
 
 
+def oob(data) -> Any:
+    """Wrap a bytes-like for frame serialization: payloads at or above the
+    out-of-band threshold ride as zero-copy iovec segments (the caller's
+    buffer is sendmsg()'d directly); smaller ones pickle in-band, where
+    the copy is cheaper than the extra segment. Used by bulk-payload call
+    sites (compiled-graph channel_write frames) so they inherit whichever
+    path is optimal without reimplementing the cutoff."""
+    m = memoryview(data)
+    if m.nbytes >= _OOB_MIN_BYTES:
+        return pickle.PickleBuffer(m)
+    return data if isinstance(data, bytes) else bytes(m)
+
+
 def _dumps_parts(obj: Any) -> List[Any]:
     """Serialize to a list of buffer segments for scatter-send.
 

@@ -184,6 +184,9 @@ class WorkerService:
         # Pins taken over from callers for not-yet-run enqueued actor work;
         # released on kill/exit so a dead actor doesn't leak its arguments.
         self._taken_pins: Dict[bytes, int] = {}
+        # Resident compiled-graph loops (dag/compiled.py) keyed by graph id.
+        self._cgraph_loops: Dict[bytes, Any] = {}
+        self._cgraph_lock = threading.Lock()
         self._shutdown = threading.Event()
         # Orphan watchdog: a worker whose NODE DAEMON is gone (daemon
         # process SIGKILLed, chaos test, host teardown race) must exit
@@ -682,6 +685,7 @@ class WorkerService:
             return self._active_calls == 0
 
     def _reset_actor_state(self) -> None:
+        self._stop_cgraph_loops()   # loops hold the dying actor instance
         with self._seq_lock:
             self.actor_id = None
             self.actor_instance = None
@@ -700,6 +704,7 @@ class WorkerService:
             # process now could take down an innocent new tenant.
             return {"ok": True, "stale": True}
         self.events.flush()
+        self._stop_cgraph_loops()
         self._release_taken_pins()
         recycled = False
         if self._recyclable():
@@ -727,6 +732,41 @@ class WorkerService:
     def rpc_ping(self) -> str:
         return "pong"
 
+    # -- compiled execution graphs (dag/compiled.py) ---------------------
+
+    def rpc_install_cgraph_loop(self, graph_id: bytes, plan: dict) -> dict:
+        """Install a resident compiled-graph loop on this actor worker.
+        Creates the actor's input rings (consumer-side ownership) and
+        starts the loop thread; normal .remote() task service continues to
+        run alongside it."""
+        if self.actor_instance is None:
+            return {"ok": False, "error": "no actor hosted on this worker"}
+        from ray_tpu.dag.compiled import CGraphWorkerLoop
+        with self._cgraph_lock:
+            if graph_id in self._cgraph_loops:
+                return {"ok": True, "dup": True}
+            loop = CGraphWorkerLoop(self, graph_id, plan)
+            self._cgraph_loops[graph_id] = loop
+        loop.start()
+        return {"ok": True}
+
+    def rpc_teardown_cgraph_loop(self, graph_id: bytes) -> dict:
+        with self._cgraph_lock:
+            loop = self._cgraph_loops.pop(graph_id, None)
+        if loop is None:
+            return {"ok": True, "stale": True}
+        loop.stop()
+        return {"ok": True}
+
+    def _stop_cgraph_loops(self) -> None:
+        with self._cgraph_lock:
+            loops, self._cgraph_loops = list(self._cgraph_loops.values()), {}
+        for loop in loops:
+            try:
+                loop.stop(join_timeout=1.0)
+            except Exception:
+                pass
+
     def rpc_debug_state(self) -> dict:
         """Structured debug-state dump (the worker's share of raylet
         debug_state.txt: execution queues, actor tenancy, seal backlog)."""
@@ -752,6 +792,8 @@ class WorkerService:
                 "taken_pins": taken_pins,
             },
             "cancelled_pending": len(self._cancelled),
+            "cgraph_loops": [lp.debug_state()
+                             for lp in self._cgraph_loops.values()],
             "fn_cache_entries": len(self._fn_cache),
             "lazy_seal_backlog": seal_backlog,
             "object_plane": self.plane.debug_state(),
@@ -766,6 +808,7 @@ class WorkerService:
                        interval_s=max(float(interval_s), 0.001))
 
     def rpc_exit(self) -> dict:
+        self._stop_cgraph_loops()
         self._release_taken_pins()
         self._shutdown.set()
         threading.Timer(0.05, lambda: os._exit(0)).start()

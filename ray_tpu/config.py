@@ -247,6 +247,24 @@ define("conductor_persist", bool, False,
        "stable path.")
 define("rpc_message_max_bytes", int, 512 * 1024 * 1024, "Max framed message size.")
 
+# Compiled execution graphs (dag/compiled.py + dag/channel.py)
+define("cgraph_slot_bytes", int, 1024 * 1024,
+       "Per-slot payload capacity of a compiled-graph channel ring. "
+       "Values whose serialized form exceeds this spill to the object "
+       "store and ride the slot as a reference marker.")
+define("cgraph_poll_us", int, 50,
+       "Sleep between channel-slot polls once the short spin window "
+       "misses (futex-free reader/writer synchronization).")
+define("cgraph_attach_timeout_s", float, 20.0,
+       "Deadline for a channel writer to find the reader-created shm "
+       "segment (covers install-order races at compile time).")
+define("cgraph_write_timeout_s", float, 60.0,
+       "Default deadline for one channel-slot write (ring full means the "
+       "consumer stalled; expiring poisons the graph).")
+define("cgraph_submit_timeout_s", float, 60.0,
+       "Default deadline for compiled.execute() to claim an in-flight "
+       "slot (max_in_flight executions already outstanding).")
+
 # TPU
 define("tpu_force_host_platform", bool, False,
        "Treat CPU devices as the TPU plane (for tests on a virtual mesh).")

@@ -78,3 +78,36 @@ class ObjectRef:
         """A concurrent.futures.Future resolving to the object's value."""
         from ray_tpu.core.api import _ref_future
         return _ref_future(self)
+
+
+class ChannelResolvedRef(ObjectRef):
+    """An ObjectRef whose value arrives over a subsystem resolver instead
+    of the object plane — compiled-graph results read from an output
+    channel (dag/compiled.py CompiledGraphRef). get()/wait() dispatch to
+    ``_resolve``/``_is_ready`` (core/api.py), so these refs compose with
+    plain ones in the public API while staying outside the distributed
+    refcount (the channel ring, not the store, owns the value's slot).
+    """
+
+    __slots__ = ()
+
+    def __init__(self, object_id: ObjectID):
+        # Deliberately skips the tracker hooks: a channel-delivered value
+        # has no store entry for the conductor ledger to count.
+        self._id = object_id
+        self._owner = None
+        self._tracked = False
+
+    def _resolve(self, timeout: Optional[float] = None):
+        """Block until the value is available; return it (or raise the
+        propagated error)."""
+        raise NotImplementedError
+
+    def _is_ready(self) -> bool:
+        """Non-blocking readiness probe for wait()."""
+        raise NotImplementedError
+
+    def __reduce__(self):
+        raise TypeError(
+            "channel-resolved refs (compiled-graph results) cannot be "
+            "serialized; get() the value and pass that instead")

@@ -1,10 +1,24 @@
 """Lazy call graphs (parity: python/ray/dag — DAGNode dag_node.py:23,
 FunctionNode function_node.py:12, ClassNode class_node.py:16, InputNode
-input_node.py:13). Build with ``fn.bind(...)``, execute with
-``dag.execute(input)``; nodes memoize within one execution."""
+input_node.py:13, MultiOutputNode output_node.py). Build with
+``fn.bind(...)``, execute with ``dag.execute(input)``; nodes memoize
+within one execution. ``dag.experimental_compile(max_in_flight=N)``
+turns a bound actor-method graph into a static plan over persistent shm
+channels (dag/compiled.py)."""
 
 from ray_tpu.dag.nodes import (ClassMethodNode, ClassNode, DAGNode,
-                               FunctionNode, InputNode)
+                               FunctionNode, InputNode, MultiOutputNode)
 
 __all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
-           "InputNode"]
+           "InputNode", "MultiOutputNode", "CompiledGraph",
+           "CompiledGraphRef"]
+
+
+def __getattr__(name):
+    # CompiledGraph/CompiledGraphRef import lazily: dag/__init__ is pulled
+    # in by the public package init, and compiled.py reaches into cluster
+    # modules that workers may not want at import time.
+    if name in ("CompiledGraph", "CompiledGraphRef"):
+        from ray_tpu.dag import compiled
+        return getattr(compiled, name)
+    raise AttributeError(name)

@@ -46,6 +46,17 @@ class DAGNode:
         from ray_tpu.core.refs import ObjectRef
         return rt.get(out) if isinstance(out, ObjectRef) else out
 
+    def experimental_compile(self, max_in_flight: int = 8,
+                             _submit_timeout: float = 60.0):
+        """Compile this bound graph into a static plan over persistent shm
+        channels (parity: dag_node.experimental_compile → CompiledDAG).
+        Returns a CompiledGraph whose execute() costs a channel write, not
+        a task submission; call teardown() to restore the actors to normal
+        task service."""
+        from ray_tpu.dag.compiled import compile_dag
+        return compile_dag(self, max_in_flight=max_in_flight,
+                           submit_timeout=_submit_timeout)
+
 
 class InputNode(DAGNode):
     """Placeholder for the value passed to execute() (input_node.py:13)."""
@@ -86,11 +97,10 @@ class ClassNode(DAGNode):
 
     def _execute_impl(self, memo, input_value):
         if self._actor_handle is None:
-            import ray_tpu as rt
             args, kwargs = self._resolve_args(memo, input_value)
-            from ray_tpu.core.refs import ObjectRef
-            args = tuple(rt.get(a) if isinstance(a, ObjectRef) else a
-                         for a in args)
+            # Upstream ObjectRefs pass straight through to .remote(): the
+            # constructing worker resolves them, instead of this process
+            # blocking on an owner-side rt.get() round trip per ref.
             self._actor_handle = self._actor_cls.remote(*args, **kwargs)
         return self._actor_handle
 
@@ -119,3 +129,30 @@ class ClassMethodNode(DAGNode):
         handle = self._class_node._execute_memo(memo, input_value)
         args, kwargs = self._resolve_args(memo, input_value)
         return getattr(handle, self._method).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Root node bundling several leaves so one execute() returns all of
+    them (parity: python/ray/dag/output_node.py MultiOutputNode)."""
+
+    def __init__(self, outputs):
+        outputs = list(outputs)
+        if not outputs:
+            raise ValueError("MultiOutputNode requires at least one output")
+        for o in outputs:
+            if not isinstance(o, DAGNode):
+                raise TypeError(
+                    f"MultiOutputNode outputs must be DAGNodes, got "
+                    f"{type(o).__name__}")
+        super().__init__(tuple(outputs), {})
+
+    def _execute_impl(self, memo, input_value):
+        args, _ = self._resolve_args(memo, input_value)
+        return list(args)
+
+    def execute(self, input_value: Any = None):
+        """Returns one value per bundled leaf, refs resolved elementwise."""
+        import ray_tpu as rt
+        from ray_tpu.core.refs import ObjectRef
+        out = self._execute_memo({}, input_value)
+        return [rt.get(o) if isinstance(o, ObjectRef) else o for o in out]

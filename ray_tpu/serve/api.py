@@ -40,6 +40,11 @@ class DeploymentHandle:
         self._ts = 0.0
         self._lock = threading.Lock()
         self._inflight: Dict[Any, int] = {}
+        # Opt-in compiled fast path (serve.run(..., compile=True)): one
+        # compiled one-step graph per replica; requests ride a persistent
+        # shm channel instead of a task submission per call.
+        self._compile = False
+        self._cgraphs: Dict[Any, Any] = {}
 
     def options(self, method_name: str) -> "DeploymentHandle":
         return DeploymentHandle(self.name, method_name)
@@ -77,11 +82,54 @@ class DeploymentHandle:
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
         args_blob = cloudpickle.dumps((args, kwargs))
+        if self._compile:
+            ref = self._remote_compiled(replica, key, args_blob)
+            if ref is not None:
+                self._track(ref, key)
+                return ref
         ref = replica.handle_request.remote(self.method, args_blob)
         # Decrement when the request actually completes (the ref resolves);
         # a single drainer thread per handle watches all outstanding refs.
         self._track(ref, key)
         return ref
+
+    def _remote_compiled(self, replica, key, args_blob):
+        """Submit through the replica's compiled graph; None means the
+        caller should fall back to the classic task path (compile failed,
+        or a prior request's exception poisoned the graph — that failed
+        request still raises its own error at get())."""
+        try:
+            with self._lock:
+                cg = self._cgraphs.get(key)
+            if cg is None:
+                from ray_tpu.dag.compiled import compile_actor_method
+                cg = compile_actor_method(
+                    replica, "handle_request", const_args=(self.method,),
+                    max_in_flight=8)
+                with self._lock:
+                    self._cgraphs[key] = cg
+            return cg.execute(args_blob)
+        except Exception:
+            with self._lock:
+                cg = self._cgraphs.pop(key, None)
+            if cg is not None:
+                try:
+                    cg.teardown()
+                except Exception:
+                    pass
+            return None
+
+    def teardown_compiled(self) -> None:
+        """Tear down this handle's compiled replica graphs (restores the
+        replicas to classic task service; safe to call repeatedly)."""
+        with self._lock:
+            graphs, self._cgraphs = list(self._cgraphs.values()), {}
+            self._compile = False
+        for cg in graphs:
+            try:
+                cg.teardown()
+            except Exception:
+                pass
 
     def _track(self, ref, key) -> None:
         with self._lock:
@@ -215,13 +263,17 @@ def _deploy_graph(app: "Application",
 
 
 def run(app, *, http_host: Optional[str] = None,
-        http_port: int = 0) -> DeploymentHandle:
+        http_port: int = 0, compile: bool = False) -> DeploymentHandle:
     """Deploy an Application (parity: serve.run), including DAGs built
-    with nested ``.bind()`` calls."""
+    with nested ``.bind()`` calls. ``compile=True`` routes the RETURNED
+    handle's requests over compiled execution graphs (dag/compiled.py):
+    per-replica persistent shm channels instead of a task submission per
+    request. Handles nested inside the graph stay on the classic path."""
     import ray_tpu as rt
     if isinstance(app, Deployment):
         app = app.bind()
     handle = _deploy_graph(app)
+    handle._compile = bool(compile)
     if http_host is not None:
         controller = _get_controller()
         port = rt.get(controller.start_http.remote(http_host, http_port),

@@ -298,6 +298,17 @@ def _fold_metrics(evs: List[tuple], dropped: int) -> None:
             m.builtin(m.Gauge, "rt_actor_push_window").set(value)
         elif kind == "fault.fired":
             m.builtin(C, "rt_faults_fired_total").inc()
+        elif kind == "cgraph.execute":
+            m.builtin(C, "rt_cgraph_executes_total").inc()
+        elif kind == "cgraph.slot.write":
+            m.builtin(C, "rt_cgraph_slot_writes_total").inc()
+            m.builtin(H, "rt_cgraph_slot_write_s",
+                      boundaries=[0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1]
+                      ).observe(value)
+        elif kind == "cgraph.slot.wait":
+            m.builtin(H, "rt_cgraph_slot_wait_s",
+                      boundaries=[0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1,
+                                  1, 10]).observe(value)
     if dropped:
         m.builtin(C, "rt_events_dropped_total").inc(dropped)
 

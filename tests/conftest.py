@@ -35,6 +35,27 @@ def pytest_configure(config):
         "marked slow")
 
 
+@pytest.fixture(autouse=True)
+def _cgraph_hygiene(request):
+    """Compiled-graph teardown hygiene (tests/test_compiled_dag.py only):
+    no test may leave a live CompiledGraph (resident loops still installed)
+    or a leaked channel shm segment behind."""
+    yield
+    if "test_compiled_dag" not in request.node.nodeid:
+        return
+    import time
+
+    from ray_tpu.dag import channel, compiled
+    assert not compiled._live_graphs, (
+        f"test leaked live compiled graphs: {compiled._live_graphs}")
+    deadline = time.monotonic() + 2.0
+    leaked = channel.leaked_segments()
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)   # store deletes are deferred a beat
+        leaked = channel.leaked_segments()
+    assert not leaked, f"test leaked channel shm segments: {leaked}"
+
+
 @pytest.fixture
 def chaos_seed():
     """Seed for a chaos schedule, printed so the exact run reproduces:

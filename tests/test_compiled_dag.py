@@ -1,0 +1,278 @@
+"""Compiled execution graphs (dag/compiled.py + dag/channel.py).
+
+Covers the static-plan lifecycle end to end: compile/execute/teardown
+round trip, multi-output graphs, max_in_flight pipelining, worker
+exception poisoning + recovery via teardown, the cross-host channel path
+(daemon forwarder), and a deterministic chaos sever of a cross-host
+channel mid-execution. The conftest hygiene fixture asserts every test
+here leaves no live graphs and no leaked channel shm segments behind.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster import fault_plane
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.core import api as core_api
+from ray_tpu.core.exceptions import GetTimeoutError, TaskError
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 16})
+    rt_ = ClusterRuntime(address=c.address)
+    core_api._runtime = rt_
+    yield c
+    core_api._runtime = None
+    rt_.shutdown()
+    c.shutdown()
+
+
+def _reap(*nodes):
+    """Free the test's actors (module-scoped cluster: CPUs must recycle)."""
+    for n in nodes:
+        h = getattr(n, "_actor_handle", None)
+        if h is not None:
+            try:
+                rt.kill(h)
+            except Exception:
+                pass
+
+
+@rt.remote
+class Worker:
+    def __init__(self):
+        self.calls = 0
+
+    def double(self, x):
+        self.calls += 1
+        return x * 2
+
+    def add(self, x, y=0):
+        self.calls += 1
+        return x + y
+
+    def slow_double(self, x):
+        time.sleep(0.5)
+        return x * 2
+
+    def boom(self, x):
+        if x == "boom":
+            raise ValueError("kaboom")
+        return x
+
+    def ncalls(self):
+        return self.calls
+
+
+def test_compile_execute_teardown(cluster):
+    node = Worker.bind()
+    with InputNode() as inp:
+        dag = node.add.bind(node.double.bind(inp), y=1)
+    cg = dag.experimental_compile()
+    try:
+        for i in range(5):
+            ref = cg.execute(i)
+            assert rt.get(ref, timeout=30) == i * 2 + 1
+    finally:
+        cg.teardown()
+    # the compiled path really ran on the actor (two steps per execute)
+    handle = node._actor_handle
+    assert rt.get(handle.ncalls.remote(), timeout=30) == 10
+    # teardown() restored normal task service on the same actor.
+    assert rt.get(handle.double.remote(21), timeout=30) == 42
+    # a torn-down graph refuses further work
+    with pytest.raises(RuntimeError, match="torn down"):
+        cg.execute(1)
+    _reap(node)
+
+
+def test_requires_input_node(cluster):
+    node = Worker.bind()
+    dag = node.double.bind(3)
+    with pytest.raises(ValueError, match="InputNode"):
+        dag.experimental_compile()
+
+
+def test_multi_output(cluster):
+    a, b = Worker.bind(), Worker.bind()
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.double.bind(inp), b.add.bind(inp, y=10)])
+    cg = dag.experimental_compile()
+    try:
+        out = rt.get(cg.execute(7), timeout=30)
+        assert out == [14, 17]
+    finally:
+        cg.teardown()
+        _reap(a, b)
+
+
+def test_multi_output_classic_execute(cluster):
+    # satellite: MultiOutputNode also works on the classic (uncompiled)
+    # path, resolving each leaf ref elementwise.
+    a = Worker.bind()
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.double.bind(inp), a.add.bind(inp, y=1)])
+    assert dag.execute(5) == [10, 6]
+    _reap(a)
+
+
+def test_classnode_passes_refs_through(cluster):
+    # satellite: ClassNode._execute_impl hands upstream ObjectRefs straight
+    # to .remote() instead of blocking on a driver-side get per ref.
+    @rt.remote
+    def seed():
+        return 5
+
+    @rt.remote
+    class Holder:
+        def __init__(self, x):
+            self.x = x
+
+        def get_x(self):
+            return self.x
+
+    with InputNode() as inp:
+        dag = Holder.bind(seed.bind()).get_x.bind()
+    # classic execution: the constructor arg was a ref the worker resolved
+    assert dag.execute() == 5
+    _reap(dag._class_node)
+
+
+def test_max_in_flight_pipelining(cluster):
+    node = Worker.bind()
+    with InputNode() as inp:
+        dag = node.slow_double.bind(inp)
+    cg = dag.experimental_compile(max_in_flight=4)
+    try:
+        t0 = time.monotonic()
+        refs = [cg.execute(i) for i in range(4)]
+        submit_s = time.monotonic() - t0
+        # submissions pipeline: 4 x 0.5s of work submitted without waiting
+        assert submit_s < 0.4
+        assert [rt.get(r, timeout=30) for r in refs] == [0, 2, 4, 6]
+        # over-submitting past the window with results never consumed
+        # times out rather than deadlocking
+        for i in range(4):
+            cg.execute(i)
+        with pytest.raises(GetTimeoutError):
+            cg.execute(99, timeout=0.3)
+    finally:
+        cg.teardown()
+        _reap(node)
+
+
+def test_wait_on_compiled_refs(cluster):
+    node = Worker.bind()
+    with InputNode() as inp:
+        dag = node.double.bind(inp)
+    cg = dag.experimental_compile()
+    try:
+        refs = [cg.execute(i) for i in range(3)]
+        ready, not_ready = rt.wait(refs, num_returns=3, timeout=30)
+        assert len(ready) == 3 and not not_ready
+        assert rt.get(ready[0], timeout=30) == 0
+    finally:
+        cg.teardown()
+        _reap(node)
+
+
+def test_result_consumed_destructively(cluster):
+    node = Worker.bind()
+    with InputNode() as inp:
+        dag = node.double.bind(inp)
+    cg = dag.experimental_compile()
+    try:
+        ref = cg.execute(2)
+        assert rt.get(ref, timeout=30) == 4
+        with pytest.raises(ValueError, match="already retrieved"):
+            rt.get(ref, timeout=5)
+    finally:
+        cg.teardown()
+        _reap(node)
+
+
+def test_exception_poisons_graph_and_teardown_recovers(cluster):
+    node = Worker.bind()
+    with InputNode() as inp:
+        dag = node.boom.bind(inp)
+    cg = dag.experimental_compile()
+    try:
+        assert rt.get(cg.execute("fine"), timeout=30) == "fine"
+        ref = cg.execute("boom")
+        with pytest.raises(TaskError, match="kaboom"):
+            rt.get(ref, timeout=30)
+        # the failure poisons the whole graph: later executes refuse
+        with pytest.raises(RuntimeError, match="poisoned"):
+            cg.execute("fine")
+    finally:
+        cg.teardown()
+    # the actor itself survived and serves classic tasks again
+    handle = node._actor_handle
+    assert rt.get(handle.double.remote(4), timeout=30) == 8
+    _reap(node)
+
+
+def test_cross_host_channel_path(cluster):
+    cluster.add_node(num_cpus=2, resources={"island": 1.0})
+    remote_node = Worker.options(resources={"island": 1.0}).bind()
+    local_node = Worker.bind()
+    with InputNode() as inp:
+        # driver -> remote host -> (cross-host channel) -> local host
+        dag = local_node.add.bind(remote_node.double.bind(inp), y=100)
+    cg = dag.experimental_compile()
+    try:
+        for i in range(6):
+            assert rt.get(cg.execute(i), timeout=60) == i * 2 + 100
+    finally:
+        cg.teardown()
+        _reap(remote_node, local_node)
+
+
+@pytest.mark.chaos
+def test_chaos_sever_cross_host_channel(cluster):
+    """Sever a cross-host channel mid-execution (seeded fault at
+    cgraph.channel.write): the graph poisons, the failing execute raises
+    within its deadline, and teardown() restores classic task service."""
+    cluster.add_node(num_cpus=2, resources={"sever_isle": 1.0})
+    node = Worker.options(resources={"sever_isle": 1.0}).bind()
+    with InputNode() as inp:
+        dag = node.double.bind(inp)
+    cg = dag.experimental_compile()
+    try:
+        assert rt.get(cg.execute(1), timeout=60) == 2
+        fault_plane.load_plan([{"site": "cgraph.channel.write",
+                                "action": "sever", "nth": 1}])
+        t0 = time.monotonic()
+        with pytest.raises(Exception, match="sever"):
+            cg.execute(2)
+        assert time.monotonic() - t0 < 10.0
+        with pytest.raises(RuntimeError, match="poisoned"):
+            cg.execute(3)
+    finally:
+        fault_plane.clear_plan()
+        cg.teardown()
+    handle = node._actor_handle
+    assert rt.get(handle.double.remote(5), timeout=60) == 10
+    _reap(node)
+
+
+def test_debug_state_reports_loops(cluster):
+    from ray_tpu.cluster.protocol import get_client
+    node = Worker.bind()
+    with InputNode() as inp:
+        dag = node.double.bind(inp)
+    cg = dag.experimental_compile()
+    try:
+        assert rt.get(cg.execute(3), timeout=30) == 6
+        plan = cg._installed[0]
+        st = get_client(plan.address).call("debug_state")
+        loops = st.get("cgraph_loops", [])
+        assert len(loops) == 1 and loops[0]["alive"]
+    finally:
+        cg.teardown()
+        _reap(node)

@@ -151,3 +151,34 @@ def test_replica_recovery(cluster):
     else:
         raise AssertionError("replica was not recovered")
     serve.delete("Fragile")
+
+
+def test_compiled_handle(cluster):
+    @serve.deployment(num_replicas=1)
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Doubler.bind(), compile=True)
+    assert handle._compile
+    # first call lazily compiles the replica graph, later ones reuse it
+    assert rt.get(handle.remote(21), timeout=60) == 42
+    assert handle._cgraphs, "compiled path was not taken"
+    for i in range(5):
+        assert rt.get(handle.remote(i), timeout=60) == i * 2
+    # pipelined submits through the same graph
+    refs = [handle.remote(i) for i in range(4)]
+    assert [rt.get(r, timeout=60) for r in refs] == [0, 2, 4, 6]
+
+    # a failing request (bad arity through the compiled graph) raises at
+    # its own get() and poisons the replica's graph; the NEXT request
+    # tears it down and transparently falls back to the classic path
+    bad = handle.remote(1, 2, 3)
+    with pytest.raises(Exception):
+        rt.get(bad, timeout=60)
+    assert rt.get(handle.remote(7), timeout=60) == 14
+
+    handle.teardown_compiled()
+    assert not handle._cgraphs
+    assert rt.get(handle.remote(8), timeout=60) == 16  # classic service
+    serve.delete("Doubler")
