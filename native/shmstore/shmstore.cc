@@ -60,6 +60,8 @@ enum Op : uint8_t {
   OP_PUT_INLINE = 10,    // create+write+seal in ONE round trip
   OP_GET_COPY_BATCH = 11,  // N inline gets in ONE round trip
   OP_CONTAINS_BATCH = 12,  // N existence checks in ONE round trip
+  OP_SPILL_CANDIDATES = 13,  // cold unreferenced primaries worth spilling
+  OP_EVICT = 14,  // evict-with-report: drop ONE sealed refcount==0 object
 };
 
 enum Status : uint8_t {
@@ -268,6 +270,23 @@ class Store {
       return ST_OK;
     }
     destroy(it);
+    return ST_OK;
+  }
+
+  // Evict-with-report: drop exactly this object's resident (or natively
+  // spilled) copy NOW, refusing anything a reader still maps or a writer
+  // still fills. The caller has already secured a durable copy elsewhere;
+  // unlike evict() this never falls back to the store's own spill dir.
+  Status evict_one(const ObjectId& id, uint64_t* freed) {
+    auto it = objects_.find(id);
+    if (it == objects_.end() || it->second.pending_delete)
+      return ST_NOT_FOUND;
+    Object& o = it->second;
+    if (o.state == CREATED) return ST_NOT_SEALED;
+    if (o.refcount > 0 && o.state != SPILLED) return ST_BUSY;
+    *freed = o.size;
+    destroy(it);
+    num_evictions_++;
     return ST_OK;
   }
 
@@ -678,6 +697,34 @@ class Server {
       }
       return reply(fd, ST_OK, out.data(), (uint32_t)out.size());
     }
+    if (op == OP_SPILL_CANDIDATES) {
+      // [op][want:u64] -> ST_OK + repeated [16B id][size:u64], coldest
+      // first, of SEALED refcount==0 resident objects totalling at least
+      // `want` bytes (or every candidate when less is available). Read-only:
+      // the external spill coordinator (node daemon) copies the bytes out
+      // through a durable backend and then issues OP_EVICT per object, so
+      // the store never blocks on spill I/O (the reference splits the same
+      // way: plasma evicts, local_object_manager.h owns the spill I/O).
+      if (len < 9) return reply(fd, ST_ERR);
+      uint64_t want;
+      memcpy(&want, p + 1, 8);
+      std::vector<std::pair<uint64_t, ObjectId>> cands;
+      for (auto& [cid, o] : store_->objects_)
+        if (o.state == SEALED && o.refcount == 0 && !o.pending_delete)
+          cands.push_back({o.lru_tick, cid});
+      std::sort(cands.begin(), cands.end(),
+                [](auto& a, auto& b) { return a.first < b.first; });
+      std::string out;
+      uint64_t total = 0;
+      for (auto& [_, cid] : cands) {
+        if (want && total >= want) break;
+        uint64_t sz = store_->objects_[cid].size;
+        out.append(cid.b, 16);
+        out.append((const char*)&sz, 8);
+        total += sz;
+      }
+      return reply(fd, ST_OK, out.data(), (uint32_t)out.size());
+    }
     if (len < 17) return reply(fd, ST_ERR);
     ObjectId id;
     memcpy(id.b, p + 1, 16);
@@ -805,6 +852,12 @@ class Server {
       }
       case OP_DELETE:
         return reply(fd, store_->del(id));
+      case OP_EVICT: {
+        uint64_t freed = 0;
+        Status st = store_->evict_one(id, &freed);
+        if (st == ST_OK) return reply(fd, ST_OK, &freed, 8);
+        return reply(fd, st);
+      }
       case OP_CONTAINS:
         return reply(fd, store_->contains(id) ? ST_OK : ST_NOT_FOUND);
       default:

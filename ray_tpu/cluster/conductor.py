@@ -87,7 +87,11 @@ class Conductor:
         self._actors: Dict[bytes, ActorInfo] = {}
         self._named_actors: Dict[Tuple[str, str], bytes] = {}
         self._object_locations: Dict[bytes, Set[bytes]] = defaultdict(set)
-        self._object_spilled: Dict[bytes, str] = {}  # oid -> spill path/url
+        # oid -> (spill url, size). Survives the writing node's death —
+        # that is the point: locate_object keeps advertising the URL so
+        # any node restores from the durable copy instead of declaring
+        # the object lost (local_object_manager.h spilled-url role).
+        self._object_spilled: Dict[bytes, tuple] = {}
         # Objects whose every registered copy died with its node (and no
         # spill). Lets locate_object tell getters "lost, stop waiting"
         # instead of being indistinguishable from not-yet-computed; cleared
@@ -102,6 +106,7 @@ class Conductor:
         self._ref_batches_seen: Set[str] = set()   # at-least-once dedup
         self._ref_batch_order: deque = deque()
         self._free_q: deque = deque()              # (node_addr, oid) deletes
+        self._spill_del_q: deque = deque()         # spill URLs to delete
         self._free_cv = threading.Condition()
         self._pgs: Dict[bytes, PlacementGroupInfo] = {}
         self._task_events: List[dict] = []
@@ -661,11 +666,30 @@ class Conductor:
                     self._lost_objects.add(oid)
                     self._cv.notify_all()
 
-    def rpc_add_spilled(self, oid: bytes, url: str) -> None:
+    def rpc_add_spilled(self, oid: bytes, url: str, size: int = 0) -> None:
         with self._cv:
             if oid in self._ref_tombstones:
-                return  # freed while the spill was in flight
-            self._object_spilled[oid] = url
+                # Freed while the spill write was in flight: the spilling
+                # daemon keeps the registry entry, so its own delete path
+                # (rpc_delete_objects -> _drop_spilled) removes the file.
+                return
+            self._object_spilled[oid] = (url, int(size))
+            self._lost_objects.discard(oid)
+            self._cv.notify_all()
+
+    def rpc_remove_spilled(self, oid: bytes, url: str) -> None:
+        """A restorer found the spill URL unreadable (node-local spill
+        dir died with its node): scrub it so locate rounds stop pointing
+        getters at a dead copy. Guarded by URL so a fresh re-spill under
+        the same oid is never scrubbed by a stale failure report."""
+        with self._cv:
+            ent = self._object_spilled.get(oid)
+            if ent is None or ent[0] != url:
+                return
+            del self._object_spilled[oid]
+            if not self._object_locations.get(oid):
+                self._object_locations.pop(oid, None)
+                self._lost_objects.add(oid)
             self._cv.notify_all()
 
     def rpc_locate_object(self, oid: bytes, timeout: float = 0.0) -> dict:
@@ -675,18 +699,20 @@ class Conductor:
             while True:
                 locs = [self._nodes[n] for n in self._object_locations.get(oid, ())
                         if n in self._nodes and self._nodes[n]["alive"]]
-                spilled = self._object_spilled.get(oid)
-                lost = not locs and not spilled and oid in self._lost_objects
-                if locs or spilled or lost or timeout <= 0:
+                sp = self._object_spilled.get(oid)
+                lost = not locs and not sp and oid in self._lost_objects
+                if locs or sp or lost or timeout <= 0:
                     return {
                         "nodes": [{"node_id": n["node_id"],
                                    "address": n["address"]} for n in locs],
-                        "spilled": spilled,
+                        "spilled": sp[0] if sp else None,
+                        "spilled_size": sp[1] if sp else 0,
                         "lost": lost,
                     }
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return {"nodes": [], "spilled": None, "lost": False}
+                    return {"nodes": [], "spilled": None,
+                            "spilled_size": 0, "lost": False}
                 self._cv.wait(min(remaining, 1.0))
 
     def rpc_objects_exist(self, oids: List[bytes]) -> List[bool]:
@@ -796,7 +822,14 @@ class Conductor:
                 info = self._nodes.get(n)
                 if info is not None and info["alive"]:
                     self._enqueue_delete(info["address"], k)
-            self._object_spilled.pop(k, None)
+            sp = self._object_spilled.pop(k, None)
+            if sp is not None:
+                # Spill copies are refcounted like any other copy: the
+                # backend file dies on the 1->0 transition (deleted off
+                # the RPC path by the free loop; the spilling daemon's
+                # own delete handler covers node-local dirs we can't
+                # reach from here).
+                self._spill_del_q.append(sp[0])
             self._lost_objects.discard(k)
             for child in self._ref_children.pop(k, ()):
                 c = self._refcounts.get(child, 0) - 1
@@ -830,11 +863,15 @@ class Conductor:
         small objects must not become thousands of serial round trips."""
         while not self._stopped:
             with self._free_cv:
-                while not self._free_q and not self._stopped:
+                while not self._free_q and not self._spill_del_q \
+                        and not self._stopped:
                     self._free_cv.wait(1.0)
                 batch = []
                 while self._free_q:
                     batch.append(self._free_q.popleft())
+                spill_urls = []
+                while self._spill_del_q:
+                    spill_urls.append(self._spill_del_q.popleft())
             by_addr: Dict[str, List[bytes]] = {}
             for addr, oid in batch:
                 by_addr.setdefault(addr, []).append(oid)
@@ -843,14 +880,25 @@ class Conductor:
                     get_client(addr).call("delete_objects", oids=oids)
                 except Exception:
                     pass
+            if spill_urls:
+                from ray_tpu.cluster import spill as _spill
+                for url in spill_urls:
+                    try:
+                        _spill.delete_url(url)
+                    except Exception:
+                        pass
 
     def rpc_free_object(self, oid: bytes) -> None:
         with self._lock:
             nodes = [self._nodes[n]["address"]
                      for n in self._object_locations.pop(oid, ())
                      if n in self._nodes and self._nodes[n]["alive"]]
-            self._object_spilled.pop(oid, None)
+            sp = self._object_spilled.pop(oid, None)
             self._lost_objects.discard(oid)
+        if sp is not None:
+            with self._free_cv:
+                self._spill_del_q.append(sp[0])
+                self._free_cv.notify()
         for addr in nodes:
             try:
                 get_client(addr).call("delete_object", oid=oid)

@@ -46,6 +46,16 @@ dict lookup, then returns — no config re-resolution, no allocation —
 so fault points stay free on the hot RPC/dispatch paths when no plan
 is loaded. The legacy ``testing_rpc_delay_us`` flag is subsumed: it is
 compiled into delay rules on the ``rpc.server.dispatch`` site.
+
+Object-tiering sites (spill/restore/evict, r12): ``object.spill.write``
+fires before the daemon writes a cold primary through the spill backend
+(raise = the write fails, the shm copy stays); ``object.spill.restore``
+fires before a plane restores from a spill URL and before a daemon
+serves a chunk from its spill file (delay models slow backends, raise
+drives the restore-failure -> remove_spilled -> reconstruction path);
+``object.evict`` fires before the shm copy of a spilled object is
+dropped (raise keeps dual copies — safe, the durable copy already
+exists).
 """
 
 from __future__ import annotations

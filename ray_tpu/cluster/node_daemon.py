@@ -280,6 +280,31 @@ class NodeDaemon:
                 threshold, self._on_memory_pressure,
                 usage_fn=mm.system_memory_usage_fraction,
                 period_s=config.get("memory_monitor_refresh_ms") / 1000.0)
+        # --- coordinated spill manager (local_object_manager.h role) ---
+        # Watches store stats at the memory-monitor cadence; past the
+        # spill threshold it writes cold unreferenced primaries through
+        # the spill backend, reports URLs to the conductor (so the copy
+        # survives this node), then evicts the shm copy.
+        self._spill_backend = None
+        self._spilled: Dict[bytes, tuple] = {}   # oid -> (url, size)
+        self._spill_lock = threading.Lock()      # registry
+        self._spill_write_lock = threading.Lock()  # one spiller at a time
+        self._num_spilled = 0
+        self._num_restored_serves = 0
+        self._spill_thread = None
+        if config.get("object_store_spill_threshold") > 0:
+            from ray_tpu.cluster.spill import SpillBackend
+            root = config.get("object_spill_dir") or os.path.join(
+                self.session_dir, "spill-coord")
+            try:
+                self._spill_backend = SpillBackend(root)
+            except Exception:
+                self._spill_backend = None  # bad root: spilling disabled
+            if self._spill_backend is not None:
+                self._spill_thread = threading.Thread(
+                    target=self._spill_loop, daemon=True,
+                    name="daemon-spill")
+                self._spill_thread.start()
 
     def _on_memory_pressure(self, usage: float) -> None:
         """Kill one worker per pressure event (rate-limited): retriable
@@ -328,6 +353,126 @@ class NodeDaemon:
         self._kill_worker(w)  # reaper reports lease/actor death
 
     # ------------------------------------------------------------------
+    # coordinated spilling (parity: local_object_manager.h:61 — the
+    # raylet component that spills primary copies past a usage threshold
+    # and reports URLs so restores survive this node's death)
+    # ------------------------------------------------------------------
+    def _spill_loop(self) -> None:
+        while not self._stopped:
+            time.sleep(config.get("memory_monitor_refresh_ms") / 1000.0)
+            try:
+                self._maybe_spill()
+            except Exception:
+                pass  # store restarting / shutdown race: next tick retries
+
+    def _maybe_spill(self) -> int:
+        threshold = config.get("object_store_spill_threshold")
+        if threshold <= 0 or self._spill_backend is None or self._stopped:
+            return 0
+        st = self.store.stats()
+        cap = st.get("capacity", 0) or 1
+        used = st.get("used", 0)
+        if used / cap < threshold:
+            return 0
+        # Spill back down to the threshold in one pass (the high/low
+        # watermark collapsed: the threshold is both trigger and target).
+        return self._spill_bytes(max(int(used - threshold * cap), 1))
+
+    def _spill_bytes(self, want: int) -> int:
+        """Spill cold unreferenced sealed primaries until ~``want`` shm
+        bytes are freed. Write-through ordering: backend write + conductor
+        URL report happen BEFORE the shm copy is evicted, so there is
+        never a moment with zero durable copies. Returns bytes freed."""
+        if self._spill_backend is None:
+            return 0
+        freed = 0
+        with self._spill_write_lock:
+            try:
+                cands = self.store.spill_candidates(want)
+            except Exception:
+                return 0
+            for oid, size in cands:
+                if freed >= want or self._stopped:
+                    break
+                with self._spill_lock:
+                    have_copy = oid in self._spilled
+                if not have_copy:
+                    view = self.store.get(oid, timeout=0.0)
+                    if view is None:
+                        continue  # deleted since the candidate scan
+                    try:
+                        fault_plane.fire("object.spill.write", oid=oid,
+                                         size=size)
+                        url = self._spill_backend.write(oid, view)
+                    except Exception:
+                        self.store.release(oid)
+                        continue  # backend write failed: keep shm copy
+                    self.store.release(oid)
+                    with self._spill_lock:
+                        self._spilled[oid] = (url, size)
+                        self._num_spilled += 1
+                    _events.emit("object.spill.write", oid.hex(),
+                                 value=float(size))
+                    try:
+                        get_client(self.conductor_address).call(
+                            "add_spilled", oid=oid, url=url, size=size)
+                    except Exception:
+                        pass  # re-advertised by the heartbeat epoch replay
+                # Durable copy exists: drop the shm copy. A refusal
+                # (re-pinned since the scan) is fine — dual copies are
+                # legal, the spill copy just waits for the next pass.
+                try:
+                    fault_plane.fire("object.evict", oid=oid)
+                except Exception:
+                    continue
+                got = self.store.evict(oid)
+                if got:
+                    freed += got
+                    _events.emit("object.evict", oid.hex(),
+                                 value=float(got))
+        return freed
+
+    def rpc_spill_request(self, want_bytes: int) -> dict:
+        """Put-side backpressure (spill-then-admit): an ObjectPlane whose
+        create hit ST_OOM asks for room instead of failing the put."""
+        if self._spill_backend is None:
+            return {"freed": 0}
+        return {"freed": self._spill_bytes(max(int(want_bytes), 1))}
+
+    def _drop_spilled(self, oid: bytes) -> None:
+        """Forget + delete this node's spill copy (object freed)."""
+        with self._spill_lock:
+            ent = self._spilled.pop(oid, None)
+        if ent is not None:
+            from ray_tpu.cluster import spill as _spill
+            _spill.delete_url(ent[0])
+
+    def _read_spilled_chunk(self, oid: bytes, offset: int,
+                            size: int) -> Optional[bytes]:
+        """Serve a chunk of an object this daemon spilled straight from
+        the spill file — no shm re-inflation (a remote pull of a cold
+        object must not evict warm objects on THIS node to make room)."""
+        with self._spill_lock:
+            ent = self._spilled.get(oid)
+        if ent is None:
+            return None
+        from ray_tpu.cluster import spill as _spill
+        fault_plane.fire("object.spill.restore", oid=oid, offset=offset)
+        path = _spill.local_path(ent[0])
+        try:
+            if path is not None:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(size)
+            else:
+                data = _spill.read_url(ent[0])[offset:offset + size]
+        except Exception:
+            return None
+        with self._spill_lock:
+            self._num_restored_serves += 1
+        return data
+
+    # ------------------------------------------------------------------
     # heartbeat / membership
     # ------------------------------------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -362,6 +507,13 @@ class NodeDaemon:
                     if oids:
                         cli.call("add_object_locations", oids=oids,
                                  node_id=self.node_id)
+                    # Spill URLs are volatile conductor state too: replay
+                    # them so restores survive a conductor failover.
+                    with self._spill_lock:
+                        spilled = dict(self._spilled)
+                    for soid, (url, size) in spilled.items():
+                        cli.call("add_spilled", oid=soid, url=url,
+                                 size=size)
                     # Commit the epoch only once the WHOLE re-advertisement
                     # landed — a half-failed attempt must re-run next beat.
                     self._conductor_epoch = reg.get("epoch", epoch)
@@ -1210,6 +1362,16 @@ class NodeDaemon:
     def rpc_object_info(self, oid: bytes) -> dict:
         view = self.store.get(oid, timeout=0.0)
         if view is None:
+            with self._spill_lock:
+                ent = self._spilled.get(oid)
+            if ent is not None:
+                # Spilled here: fetch_chunk serves from the spill file.
+                # No shm_path — same-host pullers must take the chunk
+                # path too (there is no segment to map).
+                return {"found": True, "size": ent[1],
+                        "transfers": self._serving_chunks,
+                        "served": self._served_chunks,
+                        "spilled": True}
             return {"found": False, "size": 0}
         size = view.nbytes
         self.store.release(oid)
@@ -1271,6 +1433,11 @@ class NodeDaemon:
             if view is None:
                 view = self.store.get_pinned(oid, timeout=0.0)
                 if view is None:
+                    chunk = self._read_spilled_chunk(oid, offset, size)
+                    if chunk is not None:
+                        with self._serve_lock:
+                            self._served_chunks += 1
+                        return chunk
                     raise KeyError(f"object {oid.hex()} not in store")
                 with self._serve_lock:
                     if oid not in self._serve_views \
@@ -1411,6 +1578,7 @@ class NodeDaemon:
             self.store.delete(oid)
         except Exception:
             pass
+        self._drop_spilled(oid)
 
     def rpc_delete_objects(self, oids: List[bytes]) -> None:
         """Batched GC deletes (the conductor's free loop coalesces — a
@@ -1421,6 +1589,7 @@ class NodeDaemon:
                 self.store.delete(oid)
             except Exception:
                 pass
+            self._drop_spilled(oid)
 
     def rpc_store_stats(self) -> dict:
         return self.store.stats()
@@ -1619,8 +1788,20 @@ class NodeDaemon:
             state["serving_chunks"] = self._serving_chunks
             state["served_chunks"] = self._served_chunks
             state["remote_pins"] = len(self._remote_pins)
+        # Tiering lines (raylet debug_state.txt "Spilled/Restored/Evicted"
+        # rows): coordinated registry + the store's own counters.
+        with self._spill_lock:
+            state["spilled_objects"] = len(self._spilled)
+            state["spilled_bytes"] = sum(e[1]
+                                         for e in self._spilled.values())
+            state["num_spilled"] = self._num_spilled
+            state["num_restored_serves"] = self._num_restored_serves
         try:
-            state["store"] = self.store.stats()
+            st = self.store.stats()
+            state["store"] = st
+            state["Spilled"] = st.get("spills", 0)
+            state["Restored"] = st.get("restores", 0)
+            state["Evicted"] = st.get("evictions", 0)
         except Exception:
             pass
         return state

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_STATS, \
     OP_LIST, OP_GET_COPY, OP_PUT_INLINE, OP_GET_COPY_BATCH, \
-    OP_CONTAINS_BATCH = range(1, 13)
+    OP_CONTAINS_BATCH, OP_SPILL_CANDIDATES, OP_EVICT = range(1, 15)
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_OOM, ST_TIMEOUT, ST_ERR, ST_NOT_SEALED, \
     ST_BUSY = range(8)
 
@@ -561,6 +561,36 @@ class ShmClient:
                     f"contains_batch failed: status {resp[0]}")
             out.extend(b != 0 for b in resp[1:1 + len(chunk)])
         return out
+
+    def spill_candidates(self, max_bytes: int = 0
+                         ) -> List[Tuple[bytes, int]]:
+        """Cold unreferenced SEALED primaries worth spilling, coldest
+        first, totalling at least ``max_bytes`` (0 = every candidate).
+        Read-only: the spill coordinator copies the bytes out through its
+        backend, then calls evict() per object."""
+        resp = self._call(struct.pack("<BQ", OP_SPILL_CANDIDATES, max_bytes))
+        if resp[0] != ST_OK:
+            raise ObjectStoreError(
+                f"spill_candidates failed: status {resp[0]}")
+        body = resp[1:]
+        out: List[Tuple[bytes, int]] = []
+        for i in range(0, len(body), 24):
+            oid = bytes(body[i:i + 16])
+            (size,) = struct.unpack_from("<Q", body, i + 16)
+            out.append((oid, size))
+        return out
+
+    def evict(self, oid: bytes) -> Optional[int]:
+        """Evict-with-report: drop this object's store copy NOW (the caller
+        holds a durable copy elsewhere). Returns bytes freed, or None when
+        the store refused — pinned by a reader (ST_BUSY), unsealed, or
+        already gone; refusal means the copy stays and the caller simply
+        keeps both."""
+        resp = self._call(struct.pack("<B16s", OP_EVICT, oid))
+        if resp[0] != ST_OK:
+            return None
+        (freed,) = struct.unpack("<Q", resp[1:9])
+        return freed
 
     def stats(self) -> dict:
         import json

@@ -275,13 +275,24 @@ class _LocationBatcher:
 
 class ObjectPlane:
     def __init__(self, store: object_client.ShmClient, node_id: bytes,
-                 conductor_address: str):
+                 conductor_address: str,
+                 daemon_address: Optional[str] = None):
         from ray_tpu import config
         self.store = store
         self.node_id = node_id
         self.conductor = get_client(
             conductor_address,
             reconnect_s=config.get("gcs_rpc_reconnect_s"))
+        # Local daemon (when co-resident with one): the put-side
+        # backpressure target — an ST_OOM create asks it to
+        # spill-then-admit instead of failing the put.
+        self.daemon_address = daemon_address
+        # Optional callable key -> bool set by the task runtime: True
+        # when the object is lineage-recoverable (feeds the
+        # restore-vs-reconstruct cost choice for spilled objects).
+        self.lineage_hint = None
+        self._restored_objects = 0
+        self._restored_bytes = 0
         self._pull_locks: Dict[bytes, threading.Lock] = {}
         self._pull_guard = threading.Lock()
         self._pull_budget = _ByteBudget(
@@ -330,16 +341,19 @@ class ObjectPlane:
                 blob = segments[0] if len(segments) == 1 else \
                     b"".join(bytes(memoryview(s).cast("B"))
                              for s in segments)
-                self.store.put_inline(key, blob)
+                self._with_put_backpressure(
+                    total, lambda: self.store.put_inline(key, blob))
             else:
-                w = self.store.create_writer(key, total)
-                try:
-                    off = 0
-                    for seg in segments:
-                        off += w.write_at(off, seg)
-                finally:
-                    w.close()
-                self.store.seal(key)
+                def _create():
+                    w = self.store.create_writer(key, total)
+                    try:
+                        off = 0
+                        for seg in segments:
+                            off += w.write_at(off, seg)
+                    finally:
+                        w.close()
+                    self.store.seal(key)
+                self._with_put_backpressure(total, _create)
         except object_client.ObjectStoreError as e:
             if "already exists" not in str(e):
                 raise
@@ -352,19 +366,59 @@ class ObjectPlane:
             if len(blob) <= self._inline_max():
                 # Same one-round-trip create+copy+seal fast path as
                 # put_value (raw puts and spill restores are often small).
-                self.store.put_inline(key, blob)
+                self._with_put_backpressure(
+                    len(blob), lambda: self.store.put_inline(key, blob))
             else:
-                w = self.store.create_writer(key, len(blob))
-                try:
-                    w.write_at(0, blob)
-                finally:
-                    w.close()
-                self.store.seal(key)
+                def _create():
+                    w = self.store.create_writer(key, len(blob))
+                    try:
+                        w.write_at(0, blob)
+                    finally:
+                        w.close()
+                    self.store.seal(key)
+                self._with_put_backpressure(len(blob), _create)
         except object_client.ObjectStoreError as e:
             if "already exists" not in str(e):
                 raise
         self._loc_batcher.add(key)
         return len(blob)
+
+    def _with_put_backpressure(self, nbytes: int, attempt):
+        """Run a store-create closure with spill-then-admit backpressure:
+        a create that hits ST_OOM asks the co-resident daemon to spill
+        cold objects and retries within object_spill_put_timeout_s,
+        instead of failing a put the store could admit after spilling
+        (the create-retry half of local_object_manager.h's role)."""
+        from ray_tpu import config
+        try:
+            return attempt()
+        except object_client.ObjectStoreFullError:
+            window = float(config.get("object_spill_put_timeout_s"))
+            if window <= 0 or not self.daemon_address:
+                raise
+        deadline = time.monotonic() + window
+        _events.emit("object.put.backpressure", value=float(nbytes))
+        while True:
+            freed = self._request_spill(nbytes)
+            try:
+                return attempt()
+            except object_client.ObjectStoreFullError:
+                if time.monotonic() >= deadline:
+                    raise
+                if not freed:
+                    # Nothing spillable right now (everything pinned or
+                    # below threshold granularity): wait for refs to drop.
+                    time.sleep(0.05)
+
+    def _request_spill(self, nbytes: int) -> int:
+        """Ask the local daemon to spill at least nbytes now. Returns
+        bytes actually freed (0 on any failure — caller backs off)."""
+        try:
+            resp = get_client(self.daemon_address).call(
+                "spill_request", want_bytes=int(nbytes))
+            return int(resp.get("freed", 0))
+        except Exception:
+            return 0
 
     def put_blobs_inline(self, jobs) -> None:
         """Batched seal of small blobs: one pipelined store burst for the
@@ -506,7 +560,7 @@ class ObjectPlane:
         every value deserialized over it) is garbage collected."""
         key = self._key(oid)
         # Fast path: local.
-        view = self.store.get_pinned(key, timeout=0.0)
+        view = self._get_pinned_tolerant(key)
         if view is not None:
             return view
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -527,7 +581,7 @@ class ObjectPlane:
                     f"timed out waiting for object {oid.hex()}")
             loc = self.conductor.call("locate_object", oid=key,
                                       timeout=min(remaining, 2.0))
-            view = self.store.get_pinned(key, timeout=0.0)
+            view = self._get_pinned_tolerant(key)
             if view is not None:
                 return view
             nodes = [n for n in loc["nodes"]
@@ -545,13 +599,38 @@ class ObjectPlane:
                 # (probe, pick sources, fail over internally).
                 outcome = self._pull_from(key, nodes)
                 if outcome == "ok":
-                    view = self.store.get_pinned(key, timeout=0.0)
+                    view = self._get_pinned_tolerant(key)
                     if view is not None:
                         return view
                 elif outcome in ("missing", "unreachable"):
                     # Every probed holder failed definitively.
                     holders_failed = True
-            elif not loc.get("spilled") and holders_failed:
+            if loc.get("spilled") and (not nodes or holders_failed):
+                # Third source tier: no live shm copy is reachable but a
+                # durable spill copy exists — restore it instead of
+                # declaring the object lost. When lineage could ALSO
+                # recover it, a cost heuristic may prefer re-execution
+                # (Ownership-paper recovery-cost argument).
+                size = int(loc.get("spilled_size") or 0)
+                if self._should_reconstruct(oid, size):
+                    raise ObjectLostError(
+                        oid.hex(), "spill copy bypassed: lineage "
+                        "reconstruction preferred by cost heuristic")
+                if self._restore_spilled(key, loc["spilled"], size):
+                    view = self._get_pinned_tolerant(key)
+                    if view is not None:
+                        return view
+                else:
+                    # Unreadable spill URL (a node-local spill dir died
+                    # with its node): scrub the directory entry so the
+                    # next locate round sees lost / reconstructs.
+                    try:
+                        self.conductor.call("remove_spilled", oid=key,
+                                            url=loc["spilled"])
+                    except Exception:
+                        pass
+                    holders_failed = True
+            elif not nodes and not loc.get("spilled") and holders_failed:
                 # Every holder we were pointed at failed AND the directory
                 # (now scrubbed of them by the pull's removal reports)
                 # lists none: fully lost. A reconstruction that re-creates
@@ -561,6 +640,80 @@ class ObjectPlane:
                     oid.hex(), "object has no live holders and no spill "
                     "copy (all advertised replicas failed)")
             # No location known yet (still being computed) -> loop.
+
+    def _get_pinned_tolerant(self, key: bytes) -> Optional[memoryview]:
+        """get_pinned that treats a store-side error as not-yet-available.
+        Under heavy overcommit a native spill-restore can fail transiently
+        (every resident byte pinned by readers): the getter should retry
+        within its own deadline — refs drop and space frees — rather than
+        surface a hard store error for an object that still exists."""
+        try:
+            return self.store.get_pinned(key, timeout=0.0)
+        except object_client.ObjectStoreError:
+            return None
+
+    def _should_reconstruct(self, oid: ObjectID, size: int) -> bool:
+        """Restore-vs-reconstruct cost choice for a spilled object:
+        restore costs ~size bytes of backend I/O, re-execution costs one
+        task. With the default knob (0) restore always wins; when
+        object_spill_reconstruct_min_bytes is set, objects at least that
+        large prefer lineage re-execution — IF the runtime actually holds
+        lineage for the object (the lineage_hint callback)."""
+        from ray_tpu import config
+        floor = int(config.get("object_spill_reconstruct_min_bytes"))
+        if floor <= 0 or (size and size < floor):
+            return False
+        hint = self.lineage_hint
+        try:
+            return bool(hint is not None and hint(oid))
+        except Exception:
+            return False
+
+    def _restore_spilled(self, key: bytes, url: str, size: int) -> bool:
+        """Restore one spilled object into local shm from its URL (the
+        third tier of get_view). Admitted through the same pull byte
+        budget as remote pulls; single-flight per object."""
+        from ray_tpu.cluster import spill as _spill
+        with self._pull_guard:
+            lock = self._pull_locks.setdefault(key, threading.Lock())
+        with lock:
+            if self.store.contains(key):
+                return True
+            admitted = max(size, 1)
+            self._pull_budget.acquire(admitted)
+            t0 = time.monotonic()
+            try:
+                fault_plane.fire("object.spill.restore", oid=key, url=url)
+                data = _spill.read_url(url)
+                try:
+                    if len(data) <= self._inline_max():
+                        self._with_put_backpressure(
+                            len(data),
+                            lambda: self.store.put_inline(key, data))
+                    else:
+                        def _create():
+                            w = self.store.create_writer(key, len(data))
+                            try:
+                                w.write_at(0, data)
+                            finally:
+                                w.close()
+                            self.store.seal(key)
+                        self._with_put_backpressure(len(data), _create)
+                except object_client.ObjectStoreError as e:
+                    if "already exists" not in str(e):
+                        raise
+            except Exception:
+                self._discard_partial(key)
+                return False
+            finally:
+                self._pull_budget.release(admitted)
+            self._restored_objects += 1
+            self._restored_bytes += len(data)
+            self._loc_batcher.add(key)
+            _events.emit("object.spill.restore", key.hex(),
+                         value=float(len(data)),
+                         attrs={"secs": time.monotonic() - t0})
+            return True
 
     def _pull(self, key: bytes, remote_addr: str,
               holder_id: Optional[bytes] = None) -> str:
@@ -607,7 +760,10 @@ class ObjectPlane:
                 sources = self._select_sources(holders, size)
                 self._pull_budget.acquire(size)
                 admitted = size
-                w = self.store.create_writer(key, size)
+                # Backpressured create: a pull into a full store spills
+                # cold locals to make room instead of erroring the get.
+                w = self._with_put_backpressure(
+                    size, lambda: self.store.create_writer(key, size))
                 created = True
                 try:
                     if self._shm_direct(key, w, size, holders):
@@ -893,6 +1049,8 @@ class ObjectPlane:
             "rt_pull_inflight_bytes": float(pull_used),
             "rt_pull_budget_waiters": float(pull_waiters),
             "rt_location_batch_backlog": float(loc_backlog),
+            "rt_spill_restored_objects": float(self._restored_objects),
+            "rt_spill_restored_bytes": float(self._restored_bytes),
         }
 
     def debug_state(self) -> dict:
@@ -918,7 +1076,9 @@ class ObjectPlane:
                 "dropped_total": self._loc_batcher.dropped_total,
             }
         return {"inline_cache": inline_state, "pulls": pull_state,
-                "location_batcher": batcher_state}
+                "location_batcher": batcher_state,
+                "Restored": self._restored_objects,
+                "restored_bytes": self._restored_bytes}
 
     def stop(self) -> None:
         self._loc_batcher.stop()

@@ -158,7 +158,8 @@ class WorkerService:
         self.daemon_address = daemon_address
         self.node_id = node_id
         self.store = object_client.ShmClient(store_socket, store_prefix)
-        self.plane = ObjectPlane(self.store, node_id, conductor_address)
+        self.plane = ObjectPlane(self.store, node_id, conductor_address,
+                                 daemon_address=daemon_address)
         self._sealer = _LazySealer(self.plane)
         self._ilim_gen = None       # inline-return limit, config-cached
         self._ilim_v = -1

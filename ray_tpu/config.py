@@ -134,10 +134,33 @@ define("task_inline_args", bool, True,
 define("inline_cache_max_bytes", int, 64 * 1024 * 1024,
        "Byte budget of the caller-side LRU cache of reply-carried inline "
        "results; entries are dropped when the local refcount hits zero.")
-define("object_spill_dir", str, "", "Directory for spilled objects ('' = session dir).")
+define("object_spill_dir", str, "",
+       "Coordinated-spill backend root: a directory path or a storage URI "
+       "(mock://, fsspec gs:// / s3://) handed to workflow.storage. '' = "
+       "node-local <session_dir>/spill-coord. A SHARED root (NFS dir, "
+       "bucket) is what lets spill copies outlive the node that wrote "
+       "them: on holder death the conductor still advertises the URL and "
+       "any node restores from it (local_object_manager.h role).")
 define("object_store_eviction_watermark", float, 0.8,
        "Fraction of store capacity above which LRU eviction of unreferenced "
        "sealed objects begins.")
+define("object_store_spill_threshold", float, 0.8,
+       "Store-usage fraction past which the node daemon proactively "
+       "spills cold unreferenced sealed primaries through the spill "
+       "backend (write URL -> report rpc_add_spilled -> evict shm copy), "
+       "ahead of put demand. 0 disables coordinated spilling (puts then "
+       "fail hard on ST_OOM as before).")
+define("object_spill_put_timeout_s", float, 30.0,
+       "Put-side backpressure window: a create that hits ST_OOM asks the "
+       "local daemon to spill-then-admit and retries for up to this long "
+       "before surfacing ObjectStoreFullError (0 = fail immediately, the "
+       "pre-tiering behavior).")
+define("object_spill_reconstruct_min_bytes", int, 0,
+       "Restore-vs-reconstruct cost knob: when an object is both spilled "
+       "and lineage-recoverable, objects at least this large prefer "
+       "lineage re-execution over restoring the spilled bytes (restore "
+       "cost scales with size; re-execution does not). 0 = always "
+       "restore when a spill copy exists.")
 
 # Scheduling
 define("worker_pool_min_size", int, 0, "Workers prestarted per node at boot.")

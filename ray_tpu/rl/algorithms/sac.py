@@ -32,11 +32,15 @@ class SACConfig(AlgorithmConfig):
         self.train_batch_size = 256
         # Off-policy: a high update:sample ratio is what makes SAC
         # sample-efficient (tuned on the CartPole gate: reward>=100 within
-        # ~8k env steps at these settings).
-        self.updates_per_iter = 128
+        # ~10k env steps at these settings, across seeds 0-3).
+        self.updates_per_iter = 192
         self.rollout_fragment_length = 32
         self.gamma = 0.99
-        self.tau = 0.005                  # polyak target mix
+        # Polyak mix: at 192 updates/iter a 0.005 mix leaves the targets
+        # lagging the online Q far enough that the bellman bootstrap stalls
+        # ~95 reward inside the CI budget; 0.03 tracks fast enough to clear
+        # the gate while still damping target oscillation.
+        self.tau = 0.03
         self.lr = 1e-3
         self.initial_alpha = 0.2
         self.autotune_alpha = True
@@ -48,7 +52,7 @@ class SACLearner:
     """Jitted SAC update (twin Q + policy + temperature, one step)."""
 
     def __init__(self, module_spec: dict, *, lr: float = 1e-3,
-                 gamma: float = 0.99, tau: float = 0.005,
+                 gamma: float = 0.99, tau: float = 0.03,
                  initial_alpha: float = 0.2, autotune_alpha: bool = True,
                  target_entropy_scale: float = 0.4, seed: int = 0):
         # Defaults mirror SACConfig (the tuned CartPole-gate values); the

@@ -288,6 +288,17 @@ def _fold_metrics(evs: List[tuple], dropped: int) -> None:
             m.builtin(C, "rt_pull_bytes_total").inc(value)
         elif kind == "push.chunk":
             m.builtin(C, "rt_push_bytes_total").inc(value)
+        elif kind == "object.spill.write":
+            m.builtin(C, "rt_spill_objects_total").inc()
+            m.builtin(C, "rt_spill_bytes_total").inc(value)
+        elif kind == "object.spill.restore":
+            m.builtin(C, "rt_spill_restores_total").inc()
+            m.builtin(C, "rt_spill_restore_bytes_total").inc(value)
+        elif kind == "object.evict":
+            m.builtin(C, "rt_evict_objects_total").inc()
+            m.builtin(C, "rt_evict_bytes_total").inc(value)
+        elif kind == "object.put.backpressure":
+            m.builtin(C, "rt_put_backpressure_total").inc()
         elif kind == "inline.hit":
             m.builtin(C, "rt_inline_cache_hits_total").inc(value or 1)
         elif kind == "inline.miss":

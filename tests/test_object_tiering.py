@@ -1,0 +1,373 @@
+"""Object-store tiering (r12): coordinated spill of cold primaries to a
+durable backend, spill-aware object directory, third-tier restore in
+get_view, the restore-vs-reconstruct cost heuristic, and put-side
+spill-then-admit backpressure.
+
+Test-strategy parity: the reference's test_object_spilling*.py plus the
+spill half of local_object_manager.h — but driven through the conductor
+directory and the deterministic fault plane instead of ad-hoc sleeps.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import config
+from ray_tpu.cluster import fault_plane
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.cluster.object_plane import ObjectPlane
+from ray_tpu.cluster.object_client import ObjectStoreFullError
+from ray_tpu.cluster.protocol import get_client
+from ray_tpu.core import api as core_api
+from ray_tpu.core.ids import ObjectID, store_key
+from ray_tpu.core.runtime_cluster import ClusterRuntime
+from ray_tpu.util import events
+
+
+@pytest.fixture(autouse=True)
+def _clean_overrides():
+    yield
+    for flag in ("object_spill_dir", "object_store_spill_threshold",
+                 "object_spill_put_timeout_s",
+                 "object_spill_reconstruct_min_bytes"):
+        config.clear_override(flag)
+    fault_plane.clear_plan()
+
+
+@pytest.fixture
+def make_cluster():
+    """Function-scoped: every test here kills nodes or loads fault plans,
+    so nothing is shared."""
+    made = []
+
+    def _make(head_args=None, **cluster_kw):
+        c = Cluster(initialize_head=True,
+                    head_node_args=head_args or {"num_cpus": 2},
+                    **cluster_kw)
+        rt_ = ClusterRuntime(address=c.address)
+        core_api._runtime = rt_
+        made.append((c, rt_))
+        return c, rt_
+
+    yield _make
+    fault_plane.clear_plan()
+    for c, rt_ in made:
+        core_api._runtime = None
+        try:
+            rt_.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
+
+
+def _ring_kinds(runtime, kind):
+    events.flush_now()  # ship this process's ring tail to the conductor
+    return runtime.conductor.call("get_ring_events", kind=kind)
+
+
+def _wait_spilled(runtime, key, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        loc = runtime.plane.conductor.call("locate_object", oid=key)
+        if loc.get("spilled"):
+            return loc
+        time.sleep(0.05)
+    raise AssertionError("object never registered as spilled")
+
+
+# ---------------------------------------------------------------------------
+# Overcommit: working set far past shm capacity, zero loss
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_overcommit_wave_completes_without_loss(make_cluster):
+    """A put/get working set 3x the shm store's capacity must complete
+    with every value intact and zero ObjectLostError: the spill manager
+    keeps admitting by writing cold primaries through the backend and
+    evicting, and get_view restores them on demand."""
+    config.set_override("object_store_spill_threshold", 0.3)
+    _, rt_ = make_cluster(
+        head_args={"num_cpus": 2, "object_store_bytes": 32 << 20})
+
+    n, elems = 24, 512 * 1024  # 24 x 4 MiB = 96 MiB working set
+    refs = [rt.put(np.full(elems, i, dtype=np.float64)) for i in range(n)]
+
+    # A direct spill request makes the coordinated tier's participation
+    # deterministic (the threshold loop also runs, but on its own clock).
+    freed = get_client(rt_.daemon_address).call(
+        "spill_request", want_bytes=8 << 20)["freed"]
+    assert freed >= 0
+
+    # Stream the reads: each value is checked and dropped so the pinned
+    # set stays bounded (holding 3x capacity in zero-copy views at once
+    # could never fit the store by definition).
+    for i, ref in enumerate(refs):
+        v = rt.get(ref, timeout=60)
+        assert v.shape == (elems,) and v[0] == i and v[-1] == i
+        del v
+
+    ds = get_client(rt_.daemon_address).call("debug_state")
+    assert ds["num_spilled"] > 0 and ds["Evicted"] > 0
+    assert _ring_kinds(rt_, "object.spill.write")
+    assert _ring_kinds(rt_, "object.evict")
+
+
+# ---------------------------------------------------------------------------
+# Holder death: restore from a shared spill dir, no re-execution
+# ---------------------------------------------------------------------------
+
+
+def _producer(marker_path, seed):
+    @rt.remote(resources={"B": 1.0}, num_cpus=1)
+    def produce():
+        with open(marker_path, "a") as f:
+            f.write("x")
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, 255, size=2 << 20, dtype=np.uint8)
+
+    return produce
+
+
+def _runs(marker_path):
+    try:
+        with open(marker_path) as f:
+            return len(f.read())
+    except FileNotFoundError:
+        return 0
+
+
+@pytest.mark.chaos
+def test_holder_death_restores_from_shared_spill(make_cluster, tmp_path,
+                                                 chaos_seed):
+    """Seeded holder-kill: the producing node spills its result to a
+    SHARED spill dir and dies. The getter must restore from the spill URL
+    — NOT re-execute the task — and the flight recorder must show both
+    halves of the spill round trip."""
+    config.set_override("object_spill_dir", str(tmp_path / "shared-spill"))
+    c, rt_ = make_cluster(head_args={"num_cpus": 1},
+                          health_timeout_s=2.0)
+    node_b = c.add_node(num_cpus=1, resources={"B": 1.0},
+                        object_store_bytes=64 << 20)
+    marker = str(tmp_path / "runs.txt")
+
+    ref = _producer(marker, chaos_seed).remote()
+    ready, _ = rt.wait([ref], num_returns=1, timeout=60)
+    assert ready and _runs(marker) == 1
+
+    freed = get_client(node_b.address).call(
+        "spill_request", want_bytes=1 << 30)["freed"]
+    assert freed > 0
+    key = store_key(ref.id.binary())
+    loc = _wait_spilled(rt_, key)
+    assert os.listdir(tmp_path / "shared-spill")
+
+    c.remove_node(node_b, graceful=False)  # crash: only shm holder gone
+
+    value = rt.get(ref, timeout=60)
+    expected = np.random.default_rng(chaos_seed).integers(
+        0, 255, size=2 << 20, dtype=np.uint8)
+    np.testing.assert_array_equal(value, expected)
+    assert _runs(marker) == 1, "restore must not re-execute the task"
+    assert rt_.plane._restored_objects >= 1
+    assert _ring_kinds(rt_, "object.spill.write")
+    assert _ring_kinds(rt_, "object.spill.restore")
+
+
+@pytest.mark.chaos
+def test_reconstruction_preferred_by_cost_heuristic(make_cluster, tmp_path,
+                                                    chaos_seed):
+    """With object_spill_reconstruct_min_bytes set below the object's
+    size AND lineage on hand, the cost heuristic must bypass the (valid)
+    spill copy and re-execute the producing task instead."""
+    config.set_override("object_spill_dir", str(tmp_path / "shared-spill"))
+    c, rt_ = make_cluster(head_args={"num_cpus": 1},
+                          health_timeout_s=2.0)
+    node_b = c.add_node(num_cpus=1, resources={"B": 1.0},
+                        object_store_bytes=64 << 20)
+    marker = str(tmp_path / "runs.txt")
+
+    ref = _producer(marker, chaos_seed).remote()
+    ready, _ = rt.wait([ref], num_returns=1, timeout=60)
+    assert ready and _runs(marker) == 1
+    assert get_client(node_b.address).call(
+        "spill_request", want_bytes=1 << 30)["freed"] > 0
+    key = store_key(ref.id.binary())
+    _wait_spilled(rt_, key)
+
+    c.remove_node(node_b, graceful=False)
+    c.add_node(num_cpus=1, resources={"B": 1.0})  # re-execution capacity
+    config.set_override("object_spill_reconstruct_min_bytes", 1)
+
+    value = rt.get(ref, timeout=120)
+    expected = np.random.default_rng(chaos_seed).integers(
+        0, 255, size=2 << 20, dtype=np.uint8)
+    np.testing.assert_array_equal(value, expected)
+    assert _runs(marker) == 2, "heuristic must have re-executed the task"
+    # The spill copy was bypassed, not consumed or scrubbed.
+    assert os.listdir(tmp_path / "shared-spill")
+
+
+@pytest.mark.chaos
+def test_restore_failure_scrubs_and_falls_back_to_lineage(make_cluster,
+                                                          tmp_path,
+                                                          chaos_seed):
+    """Node-LOCAL spill dir (the default): the spill files die with the
+    node's session dir. The getter's restore fails, scrubs the stale
+    directory entry (remove_spilled), and lineage reconstruction takes
+    over — spilled-but-unreadable must degrade to lost-with-recovery,
+    never hang."""
+    c, rt_ = make_cluster(head_args={"num_cpus": 1},
+                          health_timeout_s=2.0)
+    node_b = c.add_node(num_cpus=1, resources={"B": 1.0},
+                        object_store_bytes=64 << 20)
+    marker = str(tmp_path / "runs.txt")
+
+    ref = _producer(marker, chaos_seed).remote()
+    ready, _ = rt.wait([ref], num_returns=1, timeout=60)
+    assert ready and _runs(marker) == 1
+    assert get_client(node_b.address).call(
+        "spill_request", want_bytes=1 << 30)["freed"] > 0
+    key = store_key(ref.id.binary())
+    _wait_spilled(rt_, key)
+
+    c.remove_node(node_b, graceful=False)  # takes its spill files with it
+    c.add_node(num_cpus=1, resources={"B": 1.0})
+
+    value = rt.get(ref, timeout=120)
+    expected = np.random.default_rng(chaos_seed).integers(
+        0, 255, size=2 << 20, dtype=np.uint8)
+    np.testing.assert_array_equal(value, expected)
+    assert _runs(marker) == 2, "unreadable spill must fall back to lineage"
+    # The stale spill entry was scrubbed from the directory.
+    loc = rt_.plane.conductor.call("locate_object", oid=key)
+    assert loc.get("nodes"), "reconstructed copy must be registered"
+
+
+# ---------------------------------------------------------------------------
+# Directory semantics: spilled-then-node-dead is NOT lost
+# ---------------------------------------------------------------------------
+
+
+def test_spilled_then_node_dead_resolves_via_spilled_not_lost(make_cluster,
+                                                              tmp_path):
+    """Regression (r12 satellite): once a primary is spilled to a shared
+    dir, the holder node's death must leave the directory answering with
+    the spill URL — not a lost verdict — and a cold get must succeed."""
+    config.set_override("object_spill_dir", str(tmp_path / "shared-spill"))
+    c, rt_ = make_cluster(head_args={"num_cpus": 1},
+                          health_timeout_s=2.0)
+    n2 = c.add_node(num_cpus=1, object_store_bytes=64 << 20)
+    c.wait_for_nodes(2)
+
+    oid = ObjectID.from_random()
+    blob = bytes(np.arange(1 << 20, dtype=np.uint8))
+    plane2 = ObjectPlane(n2.store, n2.node_id, c.address)
+    try:
+        plane2.put_blob(oid, blob)
+        plane2._loc_batcher.flush()
+        assert get_client(n2.address).call(
+            "spill_request", want_bytes=1 << 30)["freed"] > 0
+        key = store_key(oid.binary())
+        _wait_spilled(rt_, key)
+    finally:
+        plane2.stop()
+
+    c.remove_node(n2, graceful=False)
+    # Wait for the health check to declare the node dead and scrub its
+    # locations — the spilled entry must survive that scrub.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        loc = rt_.plane.conductor.call("locate_object", oid=key)
+        if not loc.get("nodes"):
+            break
+        time.sleep(0.1)
+    assert loc.get("spilled"), "spill URL lost with the node"
+    assert not loc.get("lost"), "spilled object wrongly declared lost"
+    assert int(loc.get("spilled_size") or 0) == len(blob)
+
+    view = rt_.plane.get_view(oid, timeout=30)
+    assert bytes(view) == blob
+
+
+# ---------------------------------------------------------------------------
+# Put-side backpressure: spill-then-admit
+# ---------------------------------------------------------------------------
+
+
+def test_put_backpressure_spill_then_admit(make_cluster, monkeypatch):
+    """An ST_OOM create must ask the daemon to spill and retry — and
+    admit once space frees — instead of failing the put outright; with
+    the window disabled it must fail immediately (old behavior)."""
+    _, rt_ = make_cluster(head_args={"num_cpus": 1})
+    plane = rt_.plane
+    config.set_override("object_spill_put_timeout_s", 10.0)
+
+    calls = {"attempts": 0, "spills": 0}
+
+    def attempt():
+        calls["attempts"] += 1
+        if calls["attempts"] < 3:
+            raise ObjectStoreFullError("store full")
+        return "admitted"
+
+    monkeypatch.setattr(
+        plane, "_request_spill",
+        lambda n: calls.__setitem__("spills", calls["spills"] + 1) or 4096)
+    assert plane._with_put_backpressure(4096, attempt) == "admitted"
+    assert calls["attempts"] == 3 and calls["spills"] == 2
+    assert _ring_kinds(rt_, "object.put.backpressure")
+
+    # Window exhausted with nothing spillable: the OOM surfaces.
+    config.set_override("object_spill_put_timeout_s", 0.3)
+    monkeypatch.setattr(plane, "_request_spill", lambda n: 0)
+
+    def always_full():
+        raise ObjectStoreFullError("store full")
+
+    with pytest.raises(ObjectStoreFullError):
+        plane._with_put_backpressure(1, always_full)
+
+    # Window disabled: immediate failure, no spill requests.
+    config.set_override("object_spill_put_timeout_s", 0)
+    before = calls["spills"]
+    with pytest.raises(ObjectStoreFullError):
+        plane._with_put_backpressure(1, always_full)
+    assert calls["spills"] == before
+
+
+# ---------------------------------------------------------------------------
+# Fault plane: injected spill failures are contained
+# ---------------------------------------------------------------------------
+
+
+def test_spill_write_fault_keeps_shm_copy(make_cluster):
+    """An injected failure at object.spill.write must leave the shm copy
+    in place (freed == 0, data still readable); clearing the plan lets
+    the same request spill for real."""
+    _, rt_ = make_cluster(
+        head_args={"num_cpus": 1, "object_store_bytes": 64 << 20})
+    refs = [rt.put(np.full(512 * 1024, i, dtype=np.float64))
+            for i in range(3)]
+
+    fault_plane.load_plan([{"site": "object.spill.write",
+                            "action": "raise"}])
+    freed = get_client(rt_.daemon_address).call(
+        "spill_request", want_bytes=4 << 20)["freed"]
+    assert freed == 0, "a failed backend write must not evict anything"
+    vals = rt.get(refs, timeout=30)
+    assert all(v[0] == i for i, v in enumerate(vals))
+
+    fault_plane.clear_plan()
+    # Fresh (unpinned) primaries: with the plan cleared the same request
+    # must spill them for real, and a later get restores them.
+    refs2 = [rt.put(np.full(512 * 1024, 100 + i, dtype=np.float64))
+             for i in range(3)]
+    freed = get_client(rt_.daemon_address).call(
+        "spill_request", want_bytes=12 << 20)["freed"]
+    assert freed > 0
+    vals2 = rt.get(refs2, timeout=30)
+    assert all(v[0] == 100 + i for i, v in enumerate(vals2))

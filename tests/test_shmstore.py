@@ -117,3 +117,92 @@ def test_stats(store):
     client.put(oid(7), b"x" * 1000)
     s = client.stats()
     assert s["objects"] >= 1 and s["used"] >= 1000
+
+
+# -- coordinated-spill ops: candidates, evict-with-report, accounting ------
+
+
+def test_spill_candidates_coldest_first_with_cutoff(store):
+    client, *_ = store
+    sz = 1 << 20
+    for i in range(4):
+        client.put(oid(200 + i), b"a" * sz)
+    # touch 200: a get bumps its LRU tick, so it is no longer coldest
+    client.get(oid(200))
+    client.release(oid(200))
+    cands = client.spill_candidates(0)  # 0 = every candidate
+    assert [c[1] for c in cands] == [sz] * 4
+    assert cands[0][0] == oid(201), "coldest (untouched) must come first"
+    assert cands[-1][0] == oid(200), "recently read must come last"
+    # the byte cutoff stops at the first candidate reaching it
+    assert len(client.spill_candidates(1)) == 1
+    assert len(client.spill_candidates(sz + 1)) == 2
+    # pinned objects are never candidates
+    client.get(oid(201))
+    assert oid(201) not in [c[0] for c in client.spill_candidates(0)]
+    client.release(oid(201))
+
+
+def test_evict_accounting_and_refusals(store):
+    client, *_ = store
+    sz = 1 << 20
+    client.put(oid(210), b"b" * sz)
+    # pinned by a reader: refused, copy stays
+    client.get(oid(210))
+    assert client.evict(oid(210)) is None
+    assert client.contains(oid(210))
+    client.release(oid(210))
+    s0 = client.stats()
+    assert client.evict(oid(210)) == sz
+    assert not client.contains(oid(210))
+    s1 = client.stats()
+    assert s1["evictions"] == s0["evictions"] + 1
+    # unsealed and unknown objects are refused too
+    client.create(oid(211), 1024)
+    assert client.evict(oid(211)) is None
+    assert client.evict(oid(212)) is None
+
+
+def test_spill_file_unlinked_on_delete(store, tmp_path):
+    client, *_ = store
+    sz = 8 << 20
+    n = 12  # 96 MiB into a 64 MiB store -> LRU spill to disk
+    for i in range(n):
+        client.put(oid(300 + i), b"c" * sz)
+    s0 = client.stats()
+    assert s0["spills"] >= 1 and s0["spilled"] >= sz
+    spill_dir = tmp_path / "spill"
+    name = oid(300).hex()  # the coldest object was spilled first
+    assert name in os.listdir(spill_dir)
+    client.delete(oid(300))
+    assert not client.contains(oid(300))
+    s1 = client.stats()
+    assert s1["spilled"] == s0["spilled"] - sz
+    assert name not in os.listdir(spill_dir), \
+        "deleting a spilled object must unlink its spill file"
+
+
+def test_recycle_pool_reclaimed_before_spilling(store):
+    client, *_ = store
+    sz = 8 << 20
+    client.put(oid(400), b"d" * sz)
+    client.delete(oid(400))
+    s0 = client.stats()
+    assert s0["pool_bytes"] >= sz, "retired segment must enter the pool"
+    # same-size create reuses the pooled segment instead of a fresh shm
+    client.put(oid(401), b"e" * sz)
+    s1 = client.stats()
+    assert s1["recycles"] == s0["recycles"] + 1
+    assert s1["pool_bytes"] == s0["pool_bytes"] - sz
+    assert s1["spills"] == 0
+    client.delete(oid(401))  # 8 MiB back in the pool
+    # fill with sub-kRecycleMin objects (they can't use the pool) right
+    # up to capacity: the overflow must be satisfied by reclaiming pool
+    # pages FIRST — zero objects spilled or evicted
+    small = 128 << 10
+    n = (56 << 20) // small
+    for i in range(n + 1):  # +1: one past capacity-minus-pool
+        client.put(oid(500 + i), b"f" * small)
+    s2 = client.stats()
+    assert s2["pool_bytes"] == 0, "pressure must drain the pool first"
+    assert s2["spills"] == 0 and s2["evictions"] == 0
