@@ -46,15 +46,16 @@ def settle(seconds: float = 1.0) -> None:
 def compare_results(old: dict, new: dict, tolerance: float) -> list:
     """Regression gate over two result dicts (or whole output files —
     either shape is accepted). Compares every metric PRESENT IN BOTH whose
-    name marks it rate-like (``*_per_sec`` / ``*_gb_per_sec`` — higher is
-    better); metrics only one side has are skipped, so the gate survives
-    suite growth. Returns the list of (name, old, new, ratio) regressions
-    where ``new < tolerance * old``."""
+    name marks it higher-is-better (``*_per_sec`` / ``*_gb_per_sec`` rates
+    and ``*_efficiency`` fractions); metrics only one side has are
+    skipped, so the gate survives suite growth. Returns the list of
+    (name, old, new, ratio) regressions where ``new < tolerance * old``."""
     old = old.get("results", old)
     new = new.get("results", new)
     bad = []
     for name in sorted(set(old) & set(new)):
-        if not (name.endswith("_per_sec") or name.endswith("_gb_per_sec")):
+        if not (name.endswith("_per_sec") or name.endswith("_gb_per_sec")
+                or name.endswith("_efficiency")):
             continue
         o, n = old[name], new[name]
         if not o:
@@ -329,6 +330,79 @@ def main(argv=None) -> int:
         cg.teardown()
         for s in (s1, s2):
             ray_tpu.kill(s._actor_handle)
+
+        # -- MPMD pipeline schedules over cgraph channels (r13) -------
+        # Three views of the same machinery: raw scheduled-step turnaround
+        # with no compute (channel + program overhead), measured 1F1B
+        # efficiency against the m/(m+s-1) bubble bound (sleep stages
+        # overlap even on one core, so this gates the SCHEDULE, not the
+        # host), and the speedup over running the identical per-microbatch
+        # work as classic serial actor RPCs.
+        settle()
+        from ray_tpu.train.pipeline import CompiledPipeline, SleepStage
+
+        PipeStage = ray_tpu.remote(SleepStage)
+
+        # (a) zero-work scheduled-step roundtrip
+        nul = [PipeStage.options(num_cpus=1).remote(0.0, 0.0)
+               for _ in range(2)]
+        ray_tpu.get([a.ping.remote() for a in nul])
+        pipe = CompiledPipeline(nul, num_microbatches=4, schedule="1f1b")
+        payload = [b"x" * 64] * 4
+        pipe.step(payload)  # warm
+
+        def pipeline_step_nul():
+            pipe.step(payload)
+
+        per, _ = timed(pipeline_step_nul, min_time=2.0 * scale)
+        results["pipeline_stage_roundtrip_per_sec"] = round(1 / per, 1)
+        pipe.teardown()
+        for a in nul:
+            ray_tpu.kill(a)
+
+        # (b) measured 1F1B efficiency vs the bubble bound
+        settle()
+        fwd_s, bwd_s, s_pp, m_pp = 0.01, 0.02, 3, 6
+        stages = [PipeStage.options(num_cpus=1).remote(fwd_s, bwd_s)
+                  for _ in range(s_pp)]
+        ray_tpu.get([a.ping.remote() for a in stages])
+        pipe = CompiledPipeline(stages, num_microbatches=m_pp,
+                                schedule="1f1b")
+        payload = [b"x" * 64] * m_pp
+        effs = []
+        for i in range(5):
+            r = pipe.step(payload)
+            if i >= 1:              # step 0 has no inter-collect wall
+                effs.append(r["efficiency"])
+        effs.sort()
+        results["pipeline_1f1b_efficiency"] = round(
+            effs[len(effs) // 2], 4)
+        results["pipeline_1f1b_bubble_bound"] = round(pipe.bound, 4)
+        pipe.teardown()
+
+        # (c) same per-microbatch work, serial classic RPCs (the DP/
+        # sequential strawman: no microbatch overlap across stages)
+        def dp_style_step():
+            for _ in range(m_pp):
+                for a in stages:
+                    ray_tpu.get(a.pipe_forward.remote(0, 0, b"x"))
+                for a in reversed(stages):
+                    ray_tpu.get(a.pipe_backward.remote(0, 0, b"x"))
+
+        per_dp, _ = timed(dp_style_step, min_time=2.0 * scale,
+                          min_iters=2)
+        # pipelined wall per step, steady state
+        pipe2 = CompiledPipeline(stages, num_microbatches=m_pp,
+                                 schedule="1f1b")
+        pipe2.step(payload)
+        walls = []
+        for _ in range(3):
+            walls.append(pipe2.step(payload)["wall_s"])
+        pipe2.teardown()
+        results["pipeline_vs_dp_step_speedup"] = round(
+            per_dp / min(walls), 2)
+        for a in stages:
+            ray_tpu.kill(a)
 
         # -- actor creation throughput (zygote fork path) -------------
         # End-to-end: N actors created, first method call acked, killed.

@@ -742,11 +742,13 @@ class WorkerService:
         run alongside it."""
         if self.actor_instance is None:
             return {"ok": False, "error": "no actor hosted on this worker"}
-        from ray_tpu.dag.compiled import CGraphWorkerLoop
+        from ray_tpu.dag.compiled import CGraphWorkerLoop, ScheduledWorkerLoop
+        cls = (ScheduledWorkerLoop if plan.get("mode") == "schedule"
+               else CGraphWorkerLoop)
         with self._cgraph_lock:
             if graph_id in self._cgraph_loops:
                 return {"ok": True, "dup": True}
-            loop = CGraphWorkerLoop(self, graph_id, plan)
+            loop = cls(self, graph_id, plan)
             self._cgraph_loops[graph_id] = loop
         loop.start()
         return {"ok": True}

@@ -288,6 +288,22 @@ define("cgraph_submit_timeout_s", float, 60.0,
        "Default deadline for compiled.execute() to claim an in-flight "
        "slot (max_in_flight executions already outstanding).")
 
+# MPMD pipeline parallelism (dag/schedule.py + train/pipeline.py)
+define("pipeline_stage_channel_slots", int, 0,
+       "Ring slots per pipeline stage channel (bounds in-flight "
+       "microbatches between adjacent partitions). 0 = auto: "
+       "min(num_microbatches, total_partitions + 1), at least 2.")
+define("pipeline_slot_bytes", int, 0,
+       "Per-slot capacity of pipeline activation/gradient channels; "
+       "0 = inherit cgraph_slot_bytes. Oversized tensors spill to the "
+       "object store exactly like compiled-graph values.")
+define("pipeline_step_timeout_s", float, 120.0,
+       "Deadline for one pipelined training step's per-stage done "
+       "barrier (covers poison propagation after a stage failure).")
+define("pipeline_max_in_flight_steps", int, 2,
+       "Training steps the driver may pipeline into the schedule before "
+       "blocking on a completed step (also the done-ring depth).")
+
 # TPU
 define("tpu_force_host_platform", bool, False,
        "Treat CPU devices as the TPU plane (for tests on a virtual mesh).")

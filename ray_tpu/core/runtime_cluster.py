@@ -1833,6 +1833,40 @@ class ClusterRuntime:
                 out.append({"cat": "task_flow", "name": "task", "ph": "f",
                             "bp": "e", "id": ident, "ts": ts_us, "dur": 0,
                             "pid": pid_, "tid": tid_})
+            elif kind == "pipeline.stage.op":
+                # Per-stage pipeline lanes: one pid per compiled pipeline,
+                # one tid per stage, plus flow arrows joining microbatch m
+                # across stages (F chain opens the flow on partition 0, B
+                # chain closes it back there).
+                a = e["attrs"] or {}
+                dur = (e["value"] or 0.0) * 1e6
+                p_pid = "pipe-" + ident[:8]
+                p_tid = "stage%s" % a.get("stage", "?")
+                name = "%s p%s mb%s" % (a.get("kind", "?"),
+                                        a.get("part", "?"),
+                                        a.get("mb", "?"))
+                out.append({"cat": "pipeline", "name": name, "ph": "X",
+                            "ts": ts_us - dur, "dur": dur,
+                            "pid": p_pid, "tid": p_tid,
+                            "args": {**a, "busy_s": e["value"]}})
+                flow = a.get("flow")
+                if flow in ("s", "t", "f"):
+                    fid = "%s:%s:%s" % (ident, a.get("step", 0),
+                                        a.get("mb", 0))
+                    fev = {"cat": "pipeline_flow", "name": "mb", "ph": flow,
+                           "id": fid, "ts": ts_us - (dur if flow == "s"
+                                                     else 0), "dur": 0,
+                           "pid": p_pid, "tid": p_tid}
+                    if flow in ("t", "f"):
+                        fev["bp"] = "e"
+                    out.append(fev)
+            elif kind == "pipeline.step":
+                a = e["attrs"] or {}
+                dur = (e["value"] or 0.0) * 1e6
+                out.append({"cat": "pipeline", "name": "pipeline.step",
+                            "ph": "X", "ts": ts_us - dur, "dur": dur,
+                            "pid": "pipe-" + ident[:8], "tid": "driver",
+                            "args": {**a, "wall_s": e["value"]}})
             elif kind.startswith(("pull.", "push.")):
                 # object-transfer view (ray.timeline's transfer rows)
                 dur = e["value"] * 1e6 if kind == "pull.done" else 0

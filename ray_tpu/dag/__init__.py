@@ -11,7 +11,8 @@ from ray_tpu.dag.nodes import (ClassMethodNode, ClassNode, DAGNode,
 
 __all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
            "InputNode", "MultiOutputNode", "CompiledGraph",
-           "CompiledGraphRef"]
+           "CompiledGraphRef", "stage_programs", "bubble_bound",
+           "validate_programs", "PipeOp"]
 
 
 def __getattr__(name):
@@ -21,4 +22,8 @@ def __getattr__(name):
     if name in ("CompiledGraph", "CompiledGraphRef"):
         from ray_tpu.dag import compiled
         return getattr(compiled, name)
+    if name in ("stage_programs", "bubble_bound", "validate_programs",
+                "PipeOp"):
+        from ray_tpu.dag import schedule
+        return getattr(schedule, name)
     raise AttributeError(name)

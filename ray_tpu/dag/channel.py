@@ -50,12 +50,13 @@ from typing import Optional, Tuple
 CHANNEL_KEY_MARK = b"CGCH"
 
 _MAGIC = b"RTCH\x01\x00\x00\x00"
-_HDR = 64                 # magic(8) nslots(4) slot_bytes(4) wseq(8) aseq(8) closed(1)
+_HDR = 64                 # magic(8) nslots(4) slot_bytes(4) wseq(8) aseq(8) closed(1) pad(7) nonce(8)
 _OFF_NSLOTS = 8
 _OFF_SLOT_BYTES = 12
 _OFF_WRITE_SEQ = 16
 _OFF_ACK_SEQ = 24
 _OFF_CLOSED = 32
+_OFF_NONCE = 40
 
 _SLOT_HDR = 16            # state(1) flags(1) pad(2) len(4) seq(8)
 _EMPTY = 0
@@ -97,10 +98,17 @@ def _slot_off(idx: int, slot_bytes: int) -> int:
 class _Ring:
     """Shared slot arithmetic over one writable mapping."""
 
-    def __init__(self, mv: memoryview, nslots: int, slot_bytes: int):
+    def __init__(self, mv: memoryview, nslots: int, slot_bytes: int,
+                 nonce: Optional[bytes] = None):
         self.mv = mv
         self.nslots = nslots
         self.slot_bytes = slot_bytes
+        # Writer-side identity check: the nonce captured at attach time.
+        # If the store recycles the segment file for a NEW ring while an
+        # old writer still holds a mapping, the old writer's next write
+        # would silently corrupt the new ring — the fresh nonce turns that
+        # into a deterministic ChannelError instead.
+        self.nonce = nonce
 
     def closed(self) -> bool:
         return self.mv[_OFF_CLOSED] != 0
@@ -137,6 +145,16 @@ class _Ring:
             raise ChannelError(
                 f"payload {m.nbytes}B exceeds slot capacity "
                 f"{self.slot_bytes}B (raise cgraph_slot_bytes)")
+        if self.nonce is not None and \
+                bytes(self.mv[_OFF_NONCE:_OFF_NONCE + 8]) != self.nonce:
+            raise ChannelError(
+                "channel segment recycled under this writer "
+                "(ring nonce mismatch — stale attach)")
+        if self.closed():
+            # _wait_state only notices `closed` while polling; an EMPTY
+            # slot would otherwise accept a write into a ring whose reader
+            # already left (and whose segment may be deleted).
+            raise ChannelError("channel closed by peer")
         off = _slot_off(seq % self.nslots, self.slot_bytes)
         self._wait_state(off, _EMPTY, deadline, stop)
         mv = self.mv
@@ -203,6 +221,7 @@ class ShmChannelReader:
         mv[0:8] = _MAGIC
         struct.pack_into("<I", mv, _OFF_NSLOTS, nslots)
         struct.pack_into("<I", mv, _OFF_SLOT_BYTES, slot_bytes)
+        mv[_OFF_NONCE:_OFF_NONCE + 8] = os.urandom(8)   # ring identity
         store.seal(chan_id)   # visibility barrier: writers may now attach
         # Hold a store reference for the channel's lifetime so eviction /
         # recycling cannot unlink a live ring (released in close()).
@@ -265,7 +284,8 @@ class ShmChannelWriter:
             raise ChannelError(f"bad channel magic for {chan_id.hex()}")
         nslots = struct.unpack_from("<I", mv, _OFF_NSLOTS)[0]
         slot_bytes = struct.unpack_from("<I", mv, _OFF_SLOT_BYTES)[0]
-        self.ring = _Ring(mv, nslots, slot_bytes)
+        self.ring = _Ring(mv, nslots, slot_bytes,
+                          nonce=bytes(mv[_OFF_NONCE:_OFF_NONCE + 8]))
         self._closed = False
 
     def write(self, seq: int, payload, flags: int = 0,

@@ -607,15 +607,14 @@ def compile_actor_method(handle, method: str, const_args: tuple = (),
 
 
 # ---------------------------------------------------------------------------
-# worker side: the resident execution loop
+# worker side: the resident execution loops
 # ---------------------------------------------------------------------------
 
-class CGraphWorkerLoop:
-    """Resident loop hosted on an actor worker (installed via the
-    ``install_cgraph_loop`` RPC). Owns the actor's input rings (consumer-
-    side creation), lazily attaches its output writers (same-host shm or
-    cross-host daemon forwarder), and runs the actor's compiled steps once
-    per execution sequence number."""
+class _WorkerLoopBase:
+    """Channel plumbing shared by the resident loops: owns the actor's
+    input rings (consumer-side creation at install), lazily attaches
+    output writers (same-host shm or cross-host daemon forwarder), and
+    dispatches method calls onto the live actor instance."""
 
     def __init__(self, svc, graph_id: bytes, plan: dict):
         self.svc = svc
@@ -629,31 +628,15 @@ class CGraphWorkerLoop:
                              d["slot_bytes"])
             for d in plan["in_channels"]]
         self._writers: Dict[bytes, Any] = {}
-        # Pre-decode the constant args once (not per execution).
-        self._steps = []
-        for st in plan["steps"]:
-            self._steps.append({
-                "method": st["method"],
-                "args": [self._prep(spec) for spec in st["args"]],
-                "kwargs": {k: self._prep(v)
-                           for k, v in st["kwargs"].items()},
-                "outs": st["outs"],
-            })
         self.thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"cgraph-loop-{graph_id.hex()[:8]}")
 
-    @staticmethod
-    def _prep(spec):
-        if spec[0] == "const":
-            from ray_tpu.core import serialization
-            return ("const", serialization.loads(spec[1]))
-        return tuple(spec)
-
     def start(self) -> None:
         self.thread.start()
 
-    # -- plumbing --------------------------------------------------------
+    def _run(self) -> None:   # pragma: no cover — subclass responsibility
+        raise NotImplementedError
 
     def _writer_for(self, desc: dict):
         w = self._writers.get(desc["id"])
@@ -671,16 +654,6 @@ class CGraphWorkerLoop:
                     timeout=config.get("cgraph_write_timeout_s"),
                     stop=self.stop_ev, role="worker")
 
-    def _poison_outs(self, seq: int, blob: bytes) -> None:
-        """Every downstream ring gets the poison for this seq (rings stay
-        aligned; consumers unwind in turn)."""
-        for st in self._steps:
-            for desc in st["outs"]:
-                try:
-                    self._write_out(desc, seq, blob, FLAG_POISON)
-                except Exception:
-                    pass   # downstream gone too; driver times out instead
-
     def _call_method(self, method: str, args, kwargs):
         import inspect
         result = getattr(self.svc.actor_instance, method)(*args, **kwargs)
@@ -696,6 +669,61 @@ class CGraphWorkerLoop:
                 finally:
                     loop.close()
         return result
+
+    # -- teardown --------------------------------------------------------
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self.stop_ev.set()
+        if self.thread.is_alive():
+            self.thread.join(join_timeout)
+        for r in self._readers:
+            try:
+                r.close()
+            except Exception:
+                pass
+        for w in self._writers.values():
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._readers = []
+        self._writers = {}
+
+
+class CGraphWorkerLoop(_WorkerLoopBase):
+    """Resident loop hosted on an actor worker (installed via the
+    ``install_cgraph_loop`` RPC): runs the actor's compiled DAG steps once
+    per execution sequence number."""
+
+    def __init__(self, svc, graph_id: bytes, plan: dict):
+        super().__init__(svc, graph_id, plan)
+        # Pre-decode the constant args once (not per execution).
+        self._steps = []
+        for st in plan["steps"]:
+            self._steps.append({
+                "method": st["method"],
+                "args": [self._prep(spec) for spec in st["args"]],
+                "kwargs": {k: self._prep(v)
+                           for k, v in st["kwargs"].items()},
+                "outs": st["outs"],
+            })
+
+    @staticmethod
+    def _prep(spec):
+        if spec[0] == "const":
+            from ray_tpu.core import serialization
+            return ("const", serialization.loads(spec[1]))
+        return tuple(spec)
+
+    def _poison_outs(self, seq: int, blob: bytes) -> None:
+        """Every downstream ring gets the poison for this seq (rings stay
+        aligned; consumers unwind in turn)."""
+        for st in self._steps:
+            for desc in st["outs"]:
+                try:
+                    self._write_out(desc, seq, blob, FLAG_POISON)
+                except Exception:
+                    pass   # downstream gone too; driver times out instead
 
     # -- the loop --------------------------------------------------------
 
@@ -771,27 +799,139 @@ class CGraphWorkerLoop:
             return local[spec[1]]
         raise ValueError(f"bad argspec {spec!r}")
 
-    # -- teardown --------------------------------------------------------
-
-    def stop(self, join_timeout: float = 5.0) -> None:
-        self.stop_ev.set()
-        if self.thread.is_alive():
-            self.thread.join(join_timeout)
-        for r in self._readers:
-            try:
-                r.close()
-            except Exception:
-                pass
-        for w in self._writers.values():
-            try:
-                w.close()
-            except Exception:
-                pass
-        self._readers = []
-        self._writers = {}
-
     def debug_state(self) -> dict:
         return {"graph_id": self.graph_id.hex(), "seq": self.seq,
                 "dead": self.dead, "steps": len(self._steps),
+                "in_channels": len(self.plan.get("in_channels", ())),
+                "alive": self.thread.is_alive()}
+
+
+class ScheduledWorkerLoop(_WorkerLoopBase):
+    """Schedule-mode resident loop (``plan["mode"] == "schedule"``): runs
+    a static per-actor pipeline program (dag/schedule.py) once per
+    TRAINING STEP instead of one DAG pass per execution seq.
+
+    Channel slot sequences follow ``seq = step * stride + offset``
+    (stride = num_microbatches, offset = the microbatch index for
+    activation/gradient channels; stride 1 for the per-step done/metrics
+    channel), so a ring carries a step's whole microbatch stream in order
+    while neighbor stages overlap compute with transfer. Because the
+    schedule keeps per-channel read order equal to write order, writes
+    are DENSE per channel — the running write count is always the next
+    seq, which is where poison must land to reach a blocked (or future)
+    reader on failure."""
+
+    def __init__(self, svc, graph_id: bytes, plan: dict):
+        super().__init__(svc, graph_id, plan)
+        self._ops: List[dict] = plan["ops"]
+        self._wcount: Dict[bytes, int] = {}        # chan id -> writes done
+        self._out_descs: Dict[bytes, dict] = {}
+        for op in self._ops:
+            for desc, _stride, _off in op["writes"]:
+                self._out_descs.setdefault(desc["id"], desc)
+
+    def _write_seq_out(self, desc: dict, seq: int, blob, flags: int) -> None:
+        self._write_out(desc, seq, blob, flags)
+        self._wcount[desc["id"]] = seq + 1
+
+    def _poison_all(self, blob) -> None:
+        """Write POISON at every out channel's next-unwritten seq. Unlike
+        the DAG loop there is no single aligned seq: each channel advanced
+        a different distance into the step. Short per-write timeout: a
+        ring that is FULL has a live, catching-up reader (it will meet
+        the poison later or hit the driver deadline); a dead reader's
+        ring never drains."""
+        blob = bytes(blob)
+        for desc in self._out_descs.values():
+            try:
+                w = self._writer_for(desc)
+                _write_slot(w, self._wcount.get(desc["id"], 0), blob,
+                            FLAG_POISON, timeout=2.0, stop=None,
+                            role="worker")
+            except Exception:
+                pass
+
+    # -- the loop --------------------------------------------------------
+
+    def _run(self) -> None:
+        from ray_tpu.core.exceptions import TaskError
+        stride = int(self.plan["microbatches"])
+        while not self.stop_ev.is_set():
+            step = self.seq
+            busy_s = 0.0
+            try:
+                for opi, op in enumerate(self._ops):
+                    # Same fault point as the DAG loop: "crash" kills the
+                    # stage worker mid-schedule, "raise" poisons cleanly.
+                    _fault_plane().fire("cgraph.loop.crash",
+                                        graph=self.graph_id.hex(),
+                                        seq=step, op=opi,
+                                        stage=self.plan.get("stage"))
+                    vals: List[Any] = []
+                    poison_blob = None
+                    for ci, rstride, roff in op["reads"]:
+                        blob, flags = _read_slot(
+                            self._readers[ci], step * rstride + roff,
+                            None, stop=self.stop_ev)
+                        if flags & FLAG_POISON:
+                            poison_blob = blob
+                            break
+                        vals.append(_decode_value(blob, flags,
+                                                  self.svc.plane))
+                    if poison_blob is not None:
+                        self._poison_all(poison_blob)
+                        self.dead = True
+                        return
+                    t0 = time.perf_counter()
+                    result = self._call_method(
+                        op["method"], [*op.get("const", ()), *vals], {})
+                    dur = time.perf_counter() - t0
+                    busy_s += dur
+                    ev = op.get("ev")
+                    if ev is not None:
+                        _events().emit("pipeline.stage.op",
+                                       self.graph_id.hex()[:16], value=dur,
+                                       attrs={**ev, "step": step})
+                    if op.get("done"):
+                        # The per-step barrier payload carries the stage's
+                        # measured busy time (the driver derives pipeline
+                        # efficiency from it against the bubble bound).
+                        merged = dict(result) if isinstance(result, dict) \
+                            else {}
+                        merged["busy_s"] = busy_s
+                        merged["stage"] = self.plan.get("stage")
+                        result = merged
+                    if op["writes"]:
+                        blob, flags = _encode_value(
+                            result, self.plan["slot_bytes"], self.svc.plane)
+                        for desc, wstride, woff in op["writes"]:
+                            self._write_seq_out(desc, step * wstride + woff,
+                                                blob, flags)
+                self.seq = step + 1
+            except ChannelError as e:
+                if self.stop_ev.is_set():
+                    return
+                # Unlike the DAG loop, downstream stages and the driver
+                # are generally still reachable — poison them so the
+                # pipeline fails fast instead of by step deadline.
+                err = TaskError.from_exception(
+                    e, f"{self.svc.actor_class_name} [pipeline stage]")
+                self._poison_all(_encode_error(err))
+                self.dead = True
+                return
+            except BaseException as e:   # noqa: BLE001 — delivered as poison
+                if self.stop_ev.is_set():
+                    return
+                err = e if isinstance(e, TaskError) else \
+                    TaskError.from_exception(
+                        e, f"{self.svc.actor_class_name} [pipeline stage]")
+                self._poison_all(_encode_error(err))
+                self.dead = True
+                return
+
+    def debug_state(self) -> dict:
+        return {"graph_id": self.graph_id.hex(), "mode": "schedule",
+                "step": self.seq, "ops": len(self._ops),
+                "stage": self.plan.get("stage"), "dead": self.dead,
                 "in_channels": len(self.plan.get("in_channels", ())),
                 "alive": self.thread.is_alive()}

@@ -294,6 +294,64 @@ def transformer_loss(params, batch, cfg: TransformerConfig, *, mesh=None):
     return nll.mean()
 
 
+# ---------------------------------------------------------------------------
+# MPMD pipeline partitioning (train/pipeline.py)
+# ---------------------------------------------------------------------------
+#
+# With cfg.pp_stages > 1 the stacked layer tree is [P, layers_per_stage,
+# ...]; partition p applies slice p with the SAME _stage_apply scan the
+# single-process model uses, so a pipeline of P partitions is numerically
+# identical to the pp_stages=1 forward (layer order preserved). Partition
+# 0 additionally owns the embedding; the last partition owns final_norm +
+# lm_head and computes the loss.
+
+def transformer_partition_params(params, cfg: TransformerConfig,
+                                 part: int) -> Dict[str, Any]:
+    """Slice the full init tree down to what partition ``part`` owns."""
+    P = cfg.pp_stages
+    if P < 2:
+        raise ValueError("partitioning requires cfg.pp_stages >= 2")
+    if cfg.tied_embeddings:
+        # Tied embeddings would put one weight on two stages (grads would
+        # need a cross-stage reduction the schedule does not express).
+        raise ValueError("MPMD pipeline requires tied_embeddings=False")
+    sub: Dict[str, Any] = {
+        "layers": jax.tree.map(lambda a: a[part], params["layers"])}
+    if part == 0:
+        sub["embed"] = params["embed"]
+    if part == P - 1:
+        sub["final_norm"] = params["final_norm"]
+        sub["lm_head"] = params["lm_head"]
+    return sub
+
+
+def transformer_stage_forward(stage_params, x, positions,
+                              cfg: TransformerConfig, *, part: int,
+                              mesh=None):
+    """Forward one partition: tokens [B, S] int for partition 0 (embed
+    lookup included), activations [B, S, D] otherwise."""
+    if part == 0:
+        x = stage_params["embed"].astype(cfg.dtype)[x]
+    return _stage_apply(cfg, mesh, stage_params["layers"], x, positions)
+
+
+def transformer_stage_loss(stage_params, x, tokens,
+                           cfg: TransformerConfig, *, mesh=None):
+    """Last partition: its layer slice, then final norm + head +
+    next-token cross-entropy (same reduction as transformer_loss)."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = transformer_stage_forward(stage_params, x, positions, cfg,
+                                  part=cfg.pp_stages - 1, mesh=mesh)
+    x = _rmsnorm(x, stage_params["final_norm"])
+    logits = (x @ stage_params["lm_head"].astype(cfg.dtype)) \
+        .astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
 def transformer_num_params(cfg: TransformerConfig) -> int:
     d, f, v = cfg.d_model, cfg.ff_dim, cfg.vocab_size
     per_layer = d * cfg.n_heads * cfg.head_dim * 2 \

@@ -32,6 +32,9 @@ _LAZY = {
     "BackendExecutor": ("ray_tpu.train.backend_executor", "BackendExecutor"),
     "JaxBackend": ("ray_tpu.train.backend_executor", "JaxBackend"),
     "WorkerGroup": ("ray_tpu.train.worker_group", "WorkerGroup"),
+    "PipelineTrainer": ("ray_tpu.train.pipeline", "PipelineTrainer"),
+    "CompiledPipeline": ("ray_tpu.train.pipeline", "CompiledPipeline"),
+    "PipelineStageActor": ("ray_tpu.train.pipeline", "PipelineStageActor"),
 }
 
 __all__ = ["TrainState", "make_lm_train_step", "make_resnet_train_step",

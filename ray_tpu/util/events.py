@@ -63,6 +63,14 @@ _flusher: Optional[threading.Thread] = None
 _flusher_lock = threading.Lock()
 _flush_stop = threading.Event()
 
+# Drained-but-unacked delta: drain() advances the cursor before the ship
+# RPC, so a failed push must park its events here for the next tick or a
+# busy conductor silently loses them (metrics are folded exactly once, on
+# the first attempt).
+_ship_lock = threading.Lock()
+_unshipped: List[tuple] = []
+_unshipped_dropped = 0
+
 # slow-op watchdog: token -> (kind, ident, start_ts)
 _watch_lock = threading.Lock()
 _watch: Dict[int, Tuple[str, Optional[str], float]] = {}
@@ -320,6 +328,20 @@ def _fold_metrics(evs: List[tuple], dropped: int) -> None:
             m.builtin(H, "rt_cgraph_slot_wait_s",
                       boundaries=[0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1,
                                   1, 10]).observe(value)
+        elif kind == "pipeline.stage.op":
+            a = attrs or {}
+            k = a.get("kind", "")
+            m.builtin(C, "rt_pipeline_stage_ops_total",
+                      tag_keys=("kind",)).inc(tags={"kind": k})
+            m.builtin(H, "rt_pipeline_stage_op_s", tag_keys=("kind",),
+                      boundaries=[0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2]
+                      ).observe(value, tags={"kind": k})
+        elif kind == "pipeline.step":
+            m.builtin(C, "rt_pipeline_steps_total").inc()
+            a = attrs or {}
+            eff = a.get("efficiency")
+            if eff is not None:
+                m.builtin(m.Gauge, "rt_pipeline_efficiency").set(eff)
     if dropped:
         m.builtin(C, "rt_events_dropped_total").inc(dropped)
 
@@ -352,12 +374,18 @@ def configure(node_id, conductor_address: str,
 def heartbeat_payload() -> Optional[dict]:
     """Drain for piggybacking on an already-periodic conductor RPC (the
     daemon heartbeat): None when there is nothing to ship."""
+    global _unshipped, _unshipped_dropped
     evs, dropped = drain()
     if evs or dropped:
         try:
             _fold_metrics(evs, dropped)
         except Exception:
             pass
+    with _ship_lock:
+        if _unshipped or _unshipped_dropped:
+            evs = _unshipped + evs
+            dropped += _unshipped_dropped
+            _unshipped, _unshipped_dropped = [], 0
     if not evs and not dropped:
         return None
     return {"pid": os.getpid(), "events": evs, "dropped": dropped}
@@ -366,6 +394,7 @@ def heartbeat_payload() -> Optional[dict]:
 def flush_now() -> None:
     """One flush pass: ship the ring delta + any buffered tracing spans
     to the conductor, fold metrics, sample probes."""
+    global _unshipped, _unshipped_dropped
     addr = _conductor_addr
     if addr is None:
         return
@@ -377,8 +406,22 @@ def flush_now() -> None:
             _fold_metrics(evs, dropped)
         except Exception:
             pass
-        cli.call("push_ring_events", node_id=_node_hex, pid=os.getpid(),
-                 events=evs, dropped=dropped)
+    with _ship_lock:
+        if _unshipped or _unshipped_dropped:
+            evs = _unshipped + evs
+            dropped += _unshipped_dropped
+            _unshipped, _unshipped_dropped = [], 0
+    if evs or dropped:
+        try:
+            cli.call("push_ring_events", node_id=_node_hex, pid=os.getpid(),
+                     events=evs, dropped=dropped)
+        except Exception:
+            with _ship_lock:
+                keep = max(64, _cap or 16384)
+                merged = evs + _unshipped
+                _unshipped = merged[-keep:]
+                _unshipped_dropped += dropped + max(0, len(merged) - keep)
+            raise
     from ray_tpu.util import tracing
     if tracing.enabled():
         tracing.flush(cli)   # async replacement for the old inline flush
@@ -413,11 +456,13 @@ def stop() -> None:
 def reset_for_tests() -> None:
     """Forget ring + watchdog state (unit tests)."""
     global _buf, _cap, _seq, _cursor, _dropped, _enabled_gen
-    global _watch_next
+    global _watch_next, _unshipped, _unshipped_dropped
     _flush_stop.set()
     with _lock:
         _buf, _cap, _seq, _cursor, _dropped = [], 0, 0, 0, 0
         _enabled_gen = None
+    with _ship_lock:
+        _unshipped, _unshipped_dropped = [], 0
     with _watch_lock:
         _watch.clear()
         _watch_reported.clear()

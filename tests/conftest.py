@@ -37,11 +37,13 @@ def pytest_configure(config):
 
 @pytest.fixture(autouse=True)
 def _cgraph_hygiene(request):
-    """Compiled-graph teardown hygiene (tests/test_compiled_dag.py only):
-    no test may leave a live CompiledGraph (resident loops still installed)
-    or a leaked channel shm segment behind."""
+    """Compiled-graph teardown hygiene (compiled-dag and pipeline tests):
+    no test may leave a live CompiledGraph/CompiledPipeline (resident
+    loops still installed) or a leaked channel shm segment behind."""
     yield
-    if "test_compiled_dag" not in request.node.nodeid:
+    nodeid = request.node.nodeid
+    if ("test_compiled_dag" not in nodeid
+            and "test_pipeline_train" not in nodeid):
         return
     import time
 

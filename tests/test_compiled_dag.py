@@ -276,3 +276,106 @@ def test_debug_state_reports_loops(cluster):
     finally:
         cg.teardown()
         _reap(node)
+
+
+# ---------------------------------------------------------------------------
+# channel-layer unit tests (dag/channel.py): backpressure + recycle guard
+# ---------------------------------------------------------------------------
+
+
+def test_channel_backpressure_blocks_then_resumes(cluster):
+    """A writer that laps the reader by a full ring blocks (bounded
+    buffering IS the backpressure), then resumes the instant a slot is
+    acked."""
+    import threading
+
+    from ray_tpu.dag.channel import (ChannelTimeout, ShmChannelReader,
+                                     ShmChannelWriter, make_channel_id)
+    store = core_api._runtime.store
+    cid = make_channel_id()
+    reader = ShmChannelReader(store, cid, nslots=2, slot_bytes=64)
+    writer = ShmChannelWriter(store, cid)
+    try:
+        writer.write(0, b"a")
+        writer.write(1, b"b")
+        with pytest.raises(ChannelTimeout, match="EMPTY"):
+            writer.write(2, b"c", timeout=0.2)   # waiting on an EMPTY slot
+        unblocked = threading.Event()
+
+        def _blocked_write():
+            writer.write(2, b"c", timeout=10.0)
+            unblocked.set()
+
+        t = threading.Thread(target=_blocked_write, daemon=True)
+        t.start()
+        assert not unblocked.wait(0.2)      # still stalled: ring full
+        assert reader.read(0, timeout=5.0)[0] == b"a"
+        assert unblocked.wait(5.0), "ack did not release the writer"
+        t.join(5.0)
+        assert reader.read(1, timeout=5.0)[0] == b"b"
+        assert reader.read(2, timeout=5.0)[0] == b"c"
+    finally:
+        writer.close()
+        reader.close()
+
+
+def test_channel_reader_close_wakes_blocked_writer(cluster):
+    """close() on the consumer marks the ring closed: a writer stalled on
+    a FULL slot fails fast instead of timing out."""
+    import threading
+
+    from ray_tpu.dag.channel import (ChannelError, ShmChannelReader,
+                                     ShmChannelWriter, make_channel_id)
+    store = core_api._runtime.store
+    cid = make_channel_id()
+    reader = ShmChannelReader(store, cid, nslots=2, slot_bytes=64)
+    writer = ShmChannelWriter(store, cid)
+    try:
+        writer.write(0, b"a")
+        writer.write(1, b"b")
+        err = []
+
+        def _blocked_write():
+            try:
+                writer.write(2, b"c", timeout=30.0)
+            except ChannelError as e:
+                err.append(e)
+
+        t = threading.Thread(target=_blocked_write, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        reader.close()
+        t.join(5.0)
+        assert not t.is_alive(), "writer still blocked after reader close"
+        assert err and "closed by peer" in str(err[0])
+        # and a FRESH write (into what would be an EMPTY slot after a
+        # hypothetical wraparound) refuses up front too
+        with pytest.raises(ChannelError, match="closed by peer"):
+            writer.write(2, b"c", timeout=1.0)
+    finally:
+        writer.close()
+        reader.close()
+
+
+def test_channel_recycled_segment_nonce_guard(cluster):
+    """If the store recycles a segment for a NEW ring while an old writer
+    still holds its mapping, the nonce minted at reader-create time no
+    longer matches the one the writer captured at attach — the stale
+    write fails deterministically instead of corrupting the new ring."""
+    from ray_tpu.dag import channel as ch
+    store = core_api._runtime.store
+    cid = ch.make_channel_id()
+    reader = ch.ShmChannelReader(store, cid, nslots=2, slot_bytes=64)
+    writer = ch.ShmChannelWriter(store, cid)
+    try:
+        writer.write(0, b"a")
+        assert reader.read(0, timeout=5.0)[0] == b"a"
+        # simulate the recycle: a new ring is initialized in place (same
+        # mapping, fresh identity), exactly what ShmChannelReader.__init__
+        # does when the store hands it a reused segment
+        reader.ring.mv[ch._OFF_NONCE:ch._OFF_NONCE + 8] = bytes(8)
+        with pytest.raises(ch.ChannelError, match="nonce mismatch"):
+            writer.write(1, b"b", timeout=1.0)
+    finally:
+        writer.close()
+        reader.close()

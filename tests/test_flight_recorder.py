@@ -76,6 +76,40 @@ def test_ring_disabled_is_inert():
         events.reset_for_tests()
 
 
+def test_flush_failure_reships_drained_delta(monkeypatch):
+    """drain() moves the cursor before the push RPC, so a failed ship must
+    park the delta and resend it next tick — a busy conductor must not
+    silently lose a worker's events (the per-stage timeline lanes depend
+    on every loop's ops eventually arriving)."""
+    events.reset_for_tests()
+    config.set_override("event_ring_size", 256)
+    calls = []
+
+    class _Cli:
+        def call(self, op, **kw):
+            calls.append(kw.get("events") or [])
+            if len(calls) == 1:
+                raise OSError("conductor busy")
+
+    import ray_tpu.cluster.protocol as proto
+    monkeypatch.setattr(proto, "get_client", lambda addr: _Cli())
+    events.configure("aa", "fake:0", start_flusher=False)
+    try:
+        events.emit("test.ship", "x")
+        with pytest.raises(OSError):
+            events.flush_now()
+        events.emit("test.ship", "y")
+        events.flush_now()
+        assert len(calls) == 2
+        # second push carries BOTH the parked delta and the new event
+        names = [(e[1], e[2]) for e in calls[1]]
+        assert ("test.ship", "x") in names and ("test.ship", "y") in names
+        # nothing left parked
+        assert events.heartbeat_payload() is None
+    finally:
+        events.reset_for_tests()
+
+
 def test_fold_metrics_counts_batched_hits():
     """inline.hit/miss events carry a batch count in ``value``; a bare
     emit (value 0) must still count as one."""
