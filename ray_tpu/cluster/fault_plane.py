@@ -56,6 +56,16 @@ drives the restore-failure -> remove_spilled -> reconstruction path);
 ``object.evict`` fires before the shm copy of a spilled object is
 dropped (raise keeps dual copies — safe, the durable copy already
 exists).
+
+Serve ingress sites (r14): ``serve.proxy.admit`` fires in the HTTP
+proxy before a request is admitted (raise = shed with 503, the
+admission-rejection chaos knob); ``serve.replica.call`` fires inside
+the replica before user code runs (crash kills the replica mid-request
+— the headline chaos-SLO scenario; the handle retries the call on
+another replica); ``serve.replica.drain`` fires when the controller
+marks a replica DRAINING (raise degrades the graceful drain to an
+immediate kill). Replacement replicas re-arm per-process hit counters,
+so ``nth``-scheduled kills recur across respawns.
 """
 
 from __future__ import annotations

@@ -457,6 +457,91 @@ def main(argv=None) -> int:
         results["queued_tasks_drained_per_sec"] = round(
             n_q / (time.perf_counter() - t0), 1)
 
+        # -- serve ingress (r14): HTTP end-to-end, shed fast path, ----
+        # -- adaptive vs fixed batching -------------------------------
+        # End-to-end RPS through the proxy (admission + routing + replica
+        # call), the cost of REJECTING at the admission gate (shedding
+        # must stay cheap under overload or the gate itself melts), and
+        # @serve.batch throughput with a fixed window vs the p99-target
+        # adaptive window growing it under light latency pressure.
+        settle()
+        import urllib.request as _url
+        from ray_tpu import serve as _serve
+
+        # Fractional CPUs: the 4-CPU bench cluster still hosts earlier
+        # families' actors; controller + proxy + 2 replicas must fit.
+        @_serve.deployment(num_replicas=2, route_prefix="/bench",
+                           max_ongoing_requests=16,
+                           ray_actor_options={"num_cpus": 0.25})
+        def bench_fn(x=0):
+            return {"x": x}
+
+        bh = _serve.run(bench_fn.bind(), http_host="127.0.0.1")
+        bench_port = bh.http_port
+
+        def http_once(i):
+            req = _url.Request(
+                f"http://127.0.0.1:{bench_port}/bench",
+                data=json.dumps({"x": i}).encode(),
+                headers={"Content-Type": "application/json"})
+            return _url.urlopen(req, timeout=30).read()
+
+        for i in range(10):
+            http_once(i)   # warm routes cache + replica handles
+        import concurrent.futures as _cf
+        n_http = int(200 * scale) or 40
+        pool8 = _cf.ThreadPoolExecutor(max_workers=8)
+
+        def serve_http():
+            list(pool8.map(http_once, range(n_http)))
+
+        per, _ = timed(serve_http, min_time=1.0 * scale)
+        results["serve_http_per_sec"] = round(n_http / per, 1)
+
+        # Zero the queue budget IN THE PROXY PROCESS (a driver-local
+        # set_override only reaches processes spawned afterwards) so
+        # every request sheds at the admission gate.
+        from ray_tpu.serve.api import _get_controller
+        _ctrl = _get_controller(create=False)
+        ray_tpu.get(_ctrl.http_reconfigure.remote(
+            {"serve_max_queued_requests": 0}), timeout=30)
+
+        def shed_once(i):
+            try:
+                http_once(i)
+                return False
+            except _url.HTTPError as e:
+                return e.code == 503
+
+        def serve_shed():
+            assert all(pool8.map(shed_once, range(n_http)))
+
+        per, _ = timed(serve_shed, min_time=1.0 * scale)
+        results["serve_shed_per_sec"] = round(n_http / per, 1)
+        ray_tpu.get(_ctrl.http_reconfigure.remote(
+            {"serve_max_queued_requests": None}), timeout=30)
+        pool8.shutdown()
+        _serve.shutdown()   # frees the replicas' CPUs for later families
+
+        def bench_batch(deco):
+            @deco
+            def work(items):
+                time.sleep(0.002)  # per-flush cost batching amortizes
+                return list(items)
+
+            n_b = int(400 * scale) or 80
+            with _cf.ThreadPoolExecutor(max_workers=16) as ex:
+                t0 = time.perf_counter()
+                list(ex.map(work, range(n_b)))
+                return n_b / (time.perf_counter() - t0)
+
+        results["serve_batch_fixed_per_sec"] = round(bench_batch(
+            _serve.batch(max_batch_size=32,
+                         batch_wait_timeout_s=0.005)), 1)
+        results["serve_batch_adaptive_per_sec"] = round(bench_batch(
+            _serve.batch(max_batch_size=32, batch_wait_timeout_s=0.005,
+                         target_p99_ms=50.0)), 1)
+
         # -- node-to-node pull bandwidth (100MB) ----------------------
         # LAST: these add peer nodes, which would change the placement
         # topology the families above are measured on.

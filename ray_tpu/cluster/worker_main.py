@@ -561,6 +561,16 @@ class WorkerService:
 
         def run_sync(collect=None):
             err = ""
+            if task_id in self._cancelled:
+                # Cancelled before execution started (rt.cancel on an
+                # actor-task ref — e.g. a serve deadline): store the
+                # cancellation error, never run user code.
+                self._cancelled.discard(task_id)
+                from ray_tpu.core.exceptions import TaskCancelledError
+                self._fail_returns(task_id, num_returns,
+                                   TaskCancelledError("actor task cancelled"),
+                                   name, collect)
+                return "cancelled"
             try:
                 # Fault point: kill/fail mid-actor-task — after the seqno
                 # turn was taken, before the result stores. Exercises the
@@ -604,6 +614,14 @@ class WorkerService:
             # Ordered start, concurrent awaits (parity: async actors).
             async def run_async():
                 err = ""
+                if task_id in self._cancelled:
+                    self._cancelled.discard(task_id)
+                    from ray_tpu.core.exceptions import TaskCancelledError
+                    self._fail_returns(
+                        task_id, num_returns,
+                        TaskCancelledError("actor task cancelled"), name)
+                    unpin_args()
+                    return "cancelled"
                 try:
                     loop = asyncio.get_running_loop()
                     args, kwargs = await loop.run_in_executor(

@@ -304,6 +304,29 @@ define("pipeline_max_in_flight_steps", int, 2,
        "Training steps the driver may pipeline into the schedule before "
        "blocking on a completed step (also the done-ring depth).")
 
+# Serve ingress (serve/http_proxy.py admission control + serve/api.py
+# handle routing + serve/controller.py drain)
+define("serve_max_queued_requests", int, 200,
+       "Per-deployment proxy-side queue budget: requests waiting for an "
+       "ongoing slot past this depth are shed with 503 + Retry-After "
+       "instead of queueing unboundedly (parity: serve "
+       "max_queued_requests proxy backpressure).")
+define("serve_max_ongoing_requests", int, 8,
+       "Per-replica in-flight request cap (parity: serve "
+       "max_ongoing_requests). The handle routes only to replicas under "
+       "the cap and the proxy bounds dispatched work to "
+       "replicas x cap; deployments override with "
+       "@serve.deployment(max_ongoing_requests=N).")
+define("serve_request_timeout_s", float, 30.0,
+       "End-to-end deadline for one ingress request (queue wait + replica "
+       "call). Expiry answers 504 and cancels the in-flight call instead "
+       "of leaking it (parity: RAY_SERVE_REQUEST_PROCESSING_TIMEOUT_S).")
+define("serve_drain_timeout_s", float, 10.0,
+       "Graceful-drain window on scale-down/delete: a DRAINING replica "
+       "leaves the routing table immediately (generation bump) and gets "
+       "this long to finish in-flight requests before the kill (parity: "
+       "serve graceful_shutdown_timeout_s).")
+
 # TPU
 define("tpu_force_host_platform", bool, False,
        "Treat CPU devices as the TPU plane (for tests on a virtual mesh).")

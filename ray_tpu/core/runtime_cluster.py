@@ -1723,7 +1723,12 @@ class ClusterRuntime:
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         rec = self.submitter._lineage.get(ref.id.binary())
-        if rec is None or rec.done:
+        if rec is None:
+            # Not a plain task of ours — maybe an actor task (the serve
+            # deadline path cancels replica calls it stops waiting for).
+            self._cancel_actor_task(ref)
+            return
+        if rec.done:
             return
         rec.cancelled = True  # dropped from queues by _pump/_dep_loop
         # Best effort for an already-dispatched task: tell every leased
@@ -1743,6 +1748,40 @@ class ClusterRuntime:
             rec.task, TaskError.from_exception(
                 TaskCancelledError("task cancelled"), rec.task["name"]))
         self.submitter._unpin_args(rec)
+
+    def _cancel_actor_task(self, ref: ObjectRef) -> None:
+        """Best-effort cancel for an ACTOR task: purge it from the
+        per-actor push queue if it hasn't shipped; otherwise ask the
+        hosting worker to skip it before user code starts. A call already
+        executing is NOT interrupted (parity: ray.cancel on actor tasks
+        without force=True)."""
+        oid = ref.id.binary()
+        with self._lock:
+            actor_id = self._oid_actor.get(oid)
+            cli = self._actor_clients.get(actor_id) if actor_id else None
+        if cli is None:
+            return
+        task = None
+        with cli.cv:
+            for t in cli.queue:
+                if oid in t["return_oids"]:
+                    task = t
+                    cli.queue.remove(t)
+                    break
+        if task is not None:
+            self._store_error_returns(task, TaskError.from_exception(
+                TaskCancelledError("actor task cancelled"),
+                f"{cli.class_name}.{task['method_name']}"))
+            self._unpin_task(task)
+            return
+        # Already pushed: the return oid is task_id + 4-byte index
+        # (ids.py object_id_for_return), so the worker keys off oid[:-4].
+        addr = cli.address
+        if addr:
+            try:
+                get_client(addr).call("cancel_task", task_id=oid[:-4])
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     # placement groups (public surface lives in util/placement_group.py)

@@ -10,13 +10,21 @@ batching (@serve.batch), deployment autoscaling
 TPU-first: a deployment replica can pin TPU chips (num_tpus in
 ray_actor_options) and @serve.batch turns concurrent requests into one
 batched jitted forward — the serving analog of keeping the MXU fed.
+
+Ingress hardening (r14): the proxy sheds past per-deployment queue
+budgets (503 + Retry-After), admitted requests carry a deadline (504
+with the replica call cancelled), handles retry dead/shed calls once on
+a different replica, @serve.batch adapts its flush window to a p99
+target, and scale-down/delete drains replicas gracefully (DRAINING off
+the routing table, in-flight requests finish, then kill).
 """
 
-from ray_tpu.serve.api import (Application, Deployment, batch, delete,
-                               deployment, get_deployment_handle, run,
-                               shutdown, status)
+from ray_tpu.serve.api import (Application, Deployment, ServeCallRef, batch,
+                               delete, deployment, get_deployment_handle,
+                               run, shutdown, status)
+from ray_tpu.serve.controller import ReplicaBusyError
 from ray_tpu.serve.http_proxy import StreamingResponse
 
 __all__ = ["deployment", "run", "delete", "shutdown", "status",
            "get_deployment_handle", "batch", "Deployment", "Application",
-           "StreamingResponse"]
+           "StreamingResponse", "ServeCallRef", "ReplicaBusyError"]

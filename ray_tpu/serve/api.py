@@ -3,7 +3,9 @@
 Role parity: serve/api.py + handle.py:78 (DeploymentHandle -> Router) +
 batching (serve/batching.py). Handle routing is queue-length-aware
 power-of-two-choices over replica actors (parity: router.py:263 picks the
-replica with fewest in-flight)."""
+replica with fewest in-flight), hardened with dead-replica eviction and
+one retry on a different replica (parity: router's
+ActorReplicaWrapper failure handling + request retries)."""
 
 from __future__ import annotations
 
@@ -14,6 +16,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import cloudpickle
+
+from ray_tpu.core.refs import ChannelResolvedRef
 
 
 def _get_controller(create: bool = True):
@@ -30,6 +34,94 @@ def _get_controller(create: bool = True):
                            get_if_exists=True).remote()
 
 
+def _retryable(exc: BaseException) -> bool:
+    """True when a failed call may be retried on ANOTHER replica: the
+    replica died / its worker vanished / it shed the call at its in-flight
+    cap. User exceptions (TaskError wrapping application code) are not
+    retried — re-running user code on failure is an application policy."""
+    from ray_tpu.core.exceptions import (
+        ActorError, ObjectLostError, WorkerCrashedError)
+    from ray_tpu.serve.controller import ReplicaBusyError
+    kinds = (ActorError, WorkerCrashedError, ObjectLostError,
+             ReplicaBusyError, ConnectionError)
+    seen = 0
+    while exc is not None and seen < 8:
+        if isinstance(exc, kinds):
+            return True
+        exc = getattr(exc, "cause", None)
+        seen += 1
+    return False
+
+
+def _emit(kind: str, ident: str, value: float = 1.0, **attrs) -> None:
+    try:
+        from ray_tpu.util import events
+        events.emit(kind, ident, value=value,
+                    attrs=attrs if attrs else None)
+    except Exception:
+        pass
+
+
+class ServeCallRef(ChannelResolvedRef):
+    """Ref returned by DeploymentHandle.remote(): resolves through the
+    handle so a call that died with its replica (or was shed at the
+    replica's in-flight cap) is retried ONCE on a different replica,
+    transparently to rt.get()/rt.wait(). Timeouts cancel the in-flight
+    actor task instead of leaking it."""
+
+    __slots__ = ("_handle", "_inner", "_key", "_args_blob", "_method",
+                 "_retried")
+
+    def __init__(self, handle: "DeploymentHandle", inner, key,
+                 method: str, args_blob: bytes):
+        super().__init__(inner.id)
+        self._handle = handle
+        self._inner = inner
+        self._key = key
+        self._method = method
+        self._args_blob = args_blob
+        self._retried = False
+
+    def _resolve(self, timeout: Optional[float] = None):
+        import ray_tpu as rt
+        from ray_tpu.core.exceptions import GetTimeoutError
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None else \
+                max(0.0, deadline - time.monotonic())
+            try:
+                return rt.get(self._inner, timeout=remaining)
+            except GetTimeoutError:
+                # Deadline: the caller gets the timeout, the replica gets
+                # a cancel — the call must not keep a slot occupied (and
+                # the proxy must not leak work for clients that are gone).
+                try:
+                    rt.cancel(self._inner)
+                except Exception:
+                    pass
+                _emit("serve.timeout", self._handle.name)
+                raise
+            except Exception as e:  # noqa: BLE001
+                if self._retried or not _retryable(e):
+                    raise
+                self._retried = True
+                wait_s = 2.0 if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                inner = self._handle._resubmit(
+                    self._key, self._method, self._args_blob,
+                    wait_s=min(wait_s, 30.0))
+                if inner is None:
+                    raise
+                _emit("serve.retry", self._handle.name)
+                self._inner = inner
+                self._key = None  # key travels with the new submission
+
+    def _is_ready(self) -> bool:
+        import ray_tpu as rt
+        done, _ = rt.wait([self._inner], num_returns=1, timeout=0)
+        return bool(done)
+
+
 class DeploymentHandle:
     """Client-side router over a deployment's replicas."""
 
@@ -37,9 +129,19 @@ class DeploymentHandle:
         self.name = name
         self.method = method
         self._replicas: List[Any] = []
+        self._generation = -1
+        self._max_ongoing = 0
         self._ts = 0.0
         self._lock = threading.Lock()
         self._inflight: Dict[Any, int] = {}
+        # Evicted-replica quarantine: actor_id -> routing generation at
+        # eviction time. The controller's table lags a death by up to a
+        # reconcile period; without this a refresh at the SAME generation
+        # would re-admit the corpse and a retry could land right back on
+        # it. A generation bump (the controller noticed) lifts the
+        # quarantine.
+        self._suspects: Dict[Any, int] = {}
+        self._closed = False
         # Opt-in compiled fast path (serve.run(..., compile=True)): one
         # compiled one-step graph per replica; requests ride a persistent
         # shm channel instead of a task submission per call.
@@ -54,44 +156,158 @@ class DeploymentHandle:
         # rebuild fresh on the receiving worker (locks/caches don't ship).
         return (DeploymentHandle, (self.name, self.method))
 
-    def _refresh(self):
+    def _refresh(self, force: bool = False):
         import ray_tpu as rt
         with self._lock:
-            if time.monotonic() - self._ts < 1.0 and self._replicas:
+            if not force and time.monotonic() - self._ts < 1.0 \
+                    and self._replicas:
                 return
             controller = _get_controller(create=False)
-            self._replicas = rt.get(
-                controller.get_replicas.remote(self.name), timeout=30)
+            routing = rt.get(
+                controller.get_routing.remote(self.name), timeout=30)
+            gen = routing["generation"]
+            self._suspects = {k: g for k, g in self._suspects.items()
+                              if g == gen}
+            self._replicas = [r for r in routing["replicas"]
+                              if r._rt_actor_id not in self._suspects]
+            self._max_ongoing = routing["max_ongoing"]
+            if gen != self._generation:
+                # Membership changed: drop in-flight book entries for
+                # replicas that left (DRAINING/dead) so p2c never favors a
+                # ghost, and tear down any compiled graph pinned to one.
+                self._generation = routing["generation"]
+                live = {r._rt_actor_id for r in self._replicas}
+                for k in [k for k in self._inflight if k not in live]:
+                    self._inflight.pop(k, None)
+                dead_graphs = [self._cgraphs.pop(k) for k in
+                               list(self._cgraphs) if k not in live]
+            else:
+                dead_graphs = []
             self._ts = time.monotonic()
+        for cg in dead_graphs:
+            try:
+                cg.teardown()
+            except Exception:
+                pass
 
-    def _pick(self):
+    def _evict(self, key) -> None:
+        """Forget a replica that failed a submission mid-window — the
+        controller will reap it on its own schedule; this handle must stop
+        routing to it NOW."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r._rt_actor_id != key]
+            self._suspects[key] = self._generation
+            self._inflight.pop(key, None)
+            cg = self._cgraphs.pop(key, None)
+            self._ts = 0.0   # next pick re-fetches the routing table
+        if cg is not None:
+            try:
+                cg.teardown()
+            except Exception:
+                pass
+
+    def _pick(self, exclude=frozenset(), enforce_cap: bool = False):
         """Power-of-two-choices on locally tracked in-flight counts."""
         self._refresh()
-        if not self._replicas:
-            raise RuntimeError(f"deployment {self.name!r} has no replicas")
-        if len(self._replicas) == 1:
-            return self._replicas[0]
-        a, b = random.sample(self._replicas, 2)
+        with self._lock:
+            candidates = [r for r in self._replicas
+                          if r._rt_actor_id not in exclude]
+            if enforce_cap and self._max_ongoing > 0:
+                candidates = [
+                    r for r in candidates
+                    if self._inflight.get(r._rt_actor_id, 0) <
+                    self._max_ongoing]
+        if not candidates:
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self.name!r} has no replicas")
+            from ray_tpu.serve.controller import ReplicaBusyError
+            raise ReplicaBusyError(
+                f"all replicas of {self.name!r} at in-flight cap")
+        if len(candidates) == 1:
+            return candidates[0]
+        a, b = random.sample(candidates, 2)
         with self._lock:
             return a if self._inflight.get(a._rt_actor_id, 0) <= \
                 self._inflight.get(b._rt_actor_id, 0) else b
 
-    def remote(self, *args, **kwargs):
-        replica = self._pick()
+    def _submit(self, replica, args_blob: bytes):
         key = replica._rt_actor_id
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
-        args_blob = cloudpickle.dumps((args, kwargs))
-        if self._compile:
-            ref = self._remote_compiled(replica, key, args_blob)
-            if ref is not None:
-                self._track(ref, key)
-                return ref
         ref = replica.handle_request.remote(self.method, args_blob)
         # Decrement when the request actually completes (the ref resolves);
         # a single drainer thread per handle watches all outstanding refs.
         self._track(ref, key)
+        return ref, key
+
+    def _resubmit(self, failed_key, method: str, args_blob: bytes,
+                  wait_s: float = 0.0):
+        """Retry path for ServeCallRef: evict the failed replica, pick a
+        DIFFERENT one, submit there. The pick honors the per-replica
+        in-flight cap — a retry dumped onto a saturated replica would be
+        shed a second time and surface as a hard failure — waiting up to
+        ``wait_s`` for a slot. None when no alternative exists."""
+        from ray_tpu.serve.controller import ReplicaBusyError
+        if failed_key is not None:
+            self._evict(failed_key)
+        exclude = frozenset() if failed_key is None \
+            else frozenset({failed_key})
+        deadline = time.monotonic() + wait_s
+        while True:
+            try:
+                replica = self._pick(exclude=exclude, enforce_cap=True)
+                break
+            except ReplicaBusyError:
+                if time.monotonic() >= deadline:
+                    return None
+                time.sleep(0.005)
+            except Exception:
+                return None
+        ref, _ = self._submit(replica, args_blob)
         return ref
+
+    def remote(self, *args, **kwargs):
+        replica = self._pick()
+        args_blob = cloudpickle.dumps((args, kwargs))
+        if self._compile:
+            key = replica._rt_actor_id
+            with self._lock:
+                self._inflight[key] = self._inflight.get(key, 0) + 1
+            ref = self._remote_compiled(replica, key, args_blob)
+            if ref is not None:
+                self._track(ref, key)
+                return ref
+            with self._lock:
+                self._inflight[key] = max(
+                    0, self._inflight.get(key, 1) - 1)
+        ref, key = self._submit(replica, args_blob)
+        return ServeCallRef(self, ref, key, self.method, args_blob)
+
+    def call(self, *args, timeout: Optional[float] = None, **kwargs):
+        """Blocking call with deadline + capacity backpressure: waits for
+        a replica slot (per-replica in-flight cap), submits, resolves with
+        the one-retry policy. Raises ReplicaBusyError when no capacity
+        frees up in time, GetTimeoutError past the deadline. This is the
+        proxy's dispatch path."""
+        from ray_tpu import config
+        from ray_tpu.serve.controller import ReplicaBusyError
+        if timeout is None:
+            timeout = float(config.get("serve_request_timeout_s"))
+        deadline = time.monotonic() + timeout
+        args_blob = cloudpickle.dumps((args, kwargs))
+        while True:
+            try:
+                replica = self._pick(enforce_cap=True)
+                break
+            except ReplicaBusyError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.005)
+        ref, key = self._submit(replica, args_blob)
+        sref = ServeCallRef(self, ref, key, self.method, args_blob)
+        return sref._resolve(max(0.0, deadline - time.monotonic()))
 
     def _remote_compiled(self, replica, key, args_blob):
         """Submit through the replica's compiled graph; None means the
@@ -131,6 +347,15 @@ class DeploymentHandle:
             except Exception:
                 pass
 
+    def close(self) -> None:
+        """Stop the drainer thread and drop compiled graphs. Handles are
+        cheap to recreate; serve.shutdown() closes the memoized ones."""
+        self.teardown_compiled()
+        with self._lock:
+            self._closed = True
+            if hasattr(self, "_outstanding"):
+                self._outstanding = []
+
     def _track(self, ref, key) -> None:
         with self._lock:
             if not hasattr(self, "_outstanding"):
@@ -141,14 +366,19 @@ class DeploymentHandle:
 
     def _drain_loop(self) -> None:
         import ray_tpu as rt
-        while True:
+        while not self._closed:
             with self._lock:
                 pending = list(self._outstanding)
             if not pending:
                 time.sleep(0.02)
                 continue
-            done, _ = rt.wait([r for r, _ in pending],
-                              num_returns=1, timeout=1.0)
+            try:
+                done, _ = rt.wait([r for r, _ in pending],
+                                  num_returns=1, timeout=1.0)
+            except Exception:
+                # Runtime gone (shutdown between wait calls): this thread
+                # has nothing left to account for.
+                return
             if done:
                 done_set = set(done)
                 with self._lock:
@@ -170,7 +400,8 @@ class Deployment:
                  user_config=None, route_prefix: Optional[str] = None,
                  max_concurrent_queries: int = 100,
                  autoscaling_config: Optional[dict] = None,
-                 init_grace_s: float = 120.0):
+                 init_grace_s: float = 120.0,
+                 max_ongoing_requests: int = 0):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
@@ -183,6 +414,9 @@ class Deployment:
         # How long a spawned replica may stay silent while __init__ runs
         # (model loads) before an unanswered health ping means death.
         self.init_grace_s = init_grace_s
+        # Per-replica in-flight cap (0 = the serve_max_ongoing_requests
+        # config default). Past the cap a replica sheds instead of queues.
+        self.max_ongoing_requests = max_ongoing_requests
         self._init_args = ((), {})
 
     def options(self, **updates) -> "Deployment":
@@ -190,7 +424,7 @@ class Deployment:
                        self.num_replicas, dict(self.ray_actor_options),
                        self.user_config, self.route_prefix,
                        self.max_concurrent_queries, self.autoscaling_config,
-                       self.init_grace_s)
+                       self.init_grace_s, self.max_ongoing_requests)
         for k, v in updates.items():
             setattr(d, k, v)
         d._init_args = self._init_args
@@ -214,7 +448,8 @@ class Deployment:
             cloudpickle.dumps((init_args, init_kwargs)),
             self.num_replicas, self.ray_actor_options, self.user_config,
             self.route_prefix, self.max_concurrent_queries,
-            self.autoscaling_config, self.init_grace_s), timeout=300)
+            self.autoscaling_config, self.init_grace_s,
+            self.max_ongoing_requests), timeout=300)
         return DeploymentHandle(self.name)
 
 
@@ -297,8 +532,19 @@ def get_deployment_handle(name: str, method: str = "__call__"
     return DeploymentHandle(name, method)
 
 
+# Proxy-side handle cache: ONE handle per deployment per process. A fresh
+# handle per request would spawn a drainer thread each (leak) and reset
+# the in-flight book the p2c router and the capacity caps depend on.
+_handles: Dict[str, DeploymentHandle] = {}
+_handles_lock = threading.Lock()
+
+
 def _handle_for(name: str) -> DeploymentHandle:
-    return DeploymentHandle(name)
+    with _handles_lock:
+        h = _handles.get(name)
+        if h is None or h._closed:
+            h = _handles[name] = DeploymentHandle(name)
+        return h
 
 
 def status() -> Dict[str, dict]:
@@ -314,6 +560,14 @@ def delete(name: str) -> None:
 
 def shutdown() -> None:
     import ray_tpu as rt
+    with _handles_lock:
+        stale = list(_handles.values())
+        _handles.clear()
+    for h in stale:
+        try:
+            h.close()
+        except Exception:
+            pass
     try:
         controller = _get_controller(create=False)
     except ValueError:
@@ -335,26 +589,60 @@ _batch_states: Dict[str, dict] = {}
 _batch_states_lock = threading.Lock()
 
 
-def _batch_state(key: str) -> dict:
+def _batch_state(key: str, window_s: float) -> dict:
     with _batch_states_lock:
         st = _batch_states.get(key)
         if st is None:
-            st = _batch_states[key] = {"lock": threading.Lock(),
-                                       "pending": []}
+            import collections
+            st = _batch_states[key] = {
+                "lock": threading.Lock(), "pending": [],
+                # Adaptive window state: current flush window plus the
+                # recent per-request latencies the controller law reads.
+                "window": window_s,
+                "lat": collections.deque(maxlen=256),
+            }
         return st
 
 
+def _adapt_window(st: dict, target_p99_ms: float, base_window_s: float,
+                  batch_size: int) -> None:
+    """AIMD-flavored window law keyed off observed request p99: grow the
+    flush window multiplicatively while comfortably under the SLO target
+    (bigger batches amortize one forward over more requests), halve it the
+    moment p99 breaches (latency recovers within a flush or two). Bounds
+    keep a misconfigured target from freezing (window->0 busy-flush) or
+    stalling (window >> SLO) the pipeline."""
+    lat = sorted(st["lat"])
+    if not lat:
+        return
+    p99_ms = lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1000.0
+    lo, hi = base_window_s / 10.0, base_window_s * 10.0
+    if p99_ms > target_p99_ms:
+        st["window"] = max(lo, st["window"] * 0.5)
+    elif p99_ms < 0.8 * target_p99_ms:
+        st["window"] = min(hi, st["window"] * 1.25)
+    _emit("serve.batch.flush", "batch", value=float(batch_size),
+          window_ms=st["window"] * 1000.0, p99_ms=p99_ms)
+
+
 def batch(_fn=None, *, max_batch_size: int = 8,
-          batch_wait_timeout_s: float = 0.01):
+          batch_wait_timeout_s: float = 0.01,
+          target_p99_ms: Optional[float] = None):
     """Dynamic request batching (parity: serve/batching.py @serve.batch):
     concurrent single calls coalesce into one list-call of the wrapped
-    function — the TPU path to batched jitted forwards."""
+    function — the TPU path to batched jitted forwards.
+
+    With ``target_p99_ms`` set the flush window ADAPTS instead of staying
+    fixed: it grows while observed p99 sits under the SLO target and
+    halves on breach, so batch size tracks offered load without trading
+    away the latency budget. ``batch_wait_timeout_s`` is then the initial
+    window and anchors the adaptation bounds (x0.1 .. x10)."""
     def wrap(fn):
         import uuid
         state_key = uuid.uuid4().hex
 
         def flush():
-            st = _batch_state(state_key)
+            st = _batch_state(state_key, batch_wait_timeout_s)
             with st["lock"]:
                 batch_items = st["pending"][:]
                 st["pending"].clear()
@@ -369,13 +657,22 @@ def batch(_fn=None, *, max_batch_size: int = 8,
                     raise ValueError(
                         f"@serve.batch fn returned {len(outs)} results "
                         f"for {len(items)} inputs")
-                for (_, slot, _), out in zip(batch_items, outs):
+                for (_, slot, _, _), out in zip(batch_items, outs):
                     slot["result"] = out
                     slot["event"].set()
             except BaseException as e:  # noqa: BLE001
-                for _, slot, _ in batch_items:
+                for _, slot, _, _ in batch_items:
                     slot["error"] = e
                     slot["event"].set()
+            finally:
+                if target_p99_ms is not None:
+                    done = time.monotonic()
+                    with st["lock"]:
+                        st["lat"].extend(done - it[3]
+                                         for it in batch_items)
+                        _adapt_window(st, target_p99_ms,
+                                      batch_wait_timeout_s,
+                                      len(batch_items))
 
         @functools.wraps(fn)
         def wrapper(*call_args):
@@ -385,16 +682,18 @@ def batch(_fn=None, *, max_batch_size: int = 8,
                 self_obj, item = None, call_args[0]
             slot = {"event": threading.Event(), "result": None,
                     "error": None}
-            st = _batch_state(state_key)
+            st = _batch_state(state_key, batch_wait_timeout_s)
             do_flush = False
             with st["lock"]:
-                st["pending"].append((item, slot, self_obj))
+                st["pending"].append((item, slot, self_obj,
+                                      time.monotonic()))
                 if len(st["pending"]) >= max_batch_size:
                     do_flush = True
+                window = st["window"]
             if do_flush:
                 flush()
             else:
-                threading.Timer(batch_wait_timeout_s, flush).start()
+                threading.Timer(window, flush).start()
             slot["event"].wait(timeout=120)
             if slot["error"] is not None:
                 raise slot["error"]

@@ -1,10 +1,12 @@
 """Serve control plane: controller + replica actors + router.
 
 Role parity: serve/controller.py:73 (ServeController reconcile loop),
-_private/deployment_state.py (target vs running replicas FSM),
-_private/replica.py (replica actor wrapping the user callable),
-_private/router.py:263 (queue-length-aware replica choice),
-_private/autoscaling_policy.py (replicas from in-flight load).
+_private/deployment_state.py (target vs running replicas FSM + DRAINING
+state on scale-down), _private/replica.py (replica actor wrapping the
+user callable, per-replica in-flight cap), _private/router.py:263
+(queue-length-aware replica choice over a generation-stamped replica
+list), _private/autoscaling_policy.py (replicas from in-flight load,
+read from the metrics plane instead of per-replica RPC polls).
 """
 
 from __future__ import annotations
@@ -13,11 +15,19 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.cluster import fault_plane
+
+
+class ReplicaBusyError(Exception):
+    """A replica past its per-request in-flight cap rejected the call
+    instead of queueing it; the handle retries on another replica."""
+
 
 class Replica:
     """Actor wrapping one instance of the user's deployment callable."""
 
-    def __init__(self, cls_or_fn_blob: bytes, init_args_blob: bytes):
+    def __init__(self, cls_or_fn_blob: bytes, init_args_blob: bytes,
+                 deployment: str = "", max_ongoing: int = 0):
         import cloudpickle
         target = cloudpickle.loads(cls_or_fn_blob)
         args, kwargs = cloudpickle.loads(init_args_blob)
@@ -26,11 +36,39 @@ class Replica:
         else:
             self.callable = target
         self._inflight = 0
+        self._deployment = deployment
+        self._max_ongoing = int(max_ongoing)
+        self._inflight_lock = threading.Lock()
+
+    def _set_gauge(self) -> None:
+        # Per-deployment occupancy gauge: ships to the conductor metrics
+        # KV with this process's snapshot, where the controller's
+        # autoscaler reads it (no queue_len RPC fan-out on the hot path).
+        try:
+            from ray_tpu.util import metrics as m
+            m.builtin(m.Gauge, "rt_serve_replica_ongoing",
+                      tag_keys=("deployment",)).set(
+                float(self._inflight),
+                tags={"deployment": self._deployment})
+        except Exception:
+            pass
 
     def handle_request(self, method: str, args_blob: bytes):
         import cloudpickle
+        fault_plane.fire("serve.replica.call", deployment=self._deployment,
+                         method=method)
+        with self._inflight_lock:
+            if self._max_ongoing and self._inflight >= self._max_ongoing:
+                # Reject past the cap instead of queueing: the handle sees
+                # ReplicaBusyError and re-picks — backpressure propagates
+                # replica -> handle -> proxy instead of hiding in an
+                # unbounded actor mailbox.
+                raise ReplicaBusyError(
+                    f"replica of {self._deployment!r} at in-flight cap "
+                    f"({self._max_ongoing})")
+            self._inflight += 1
+        self._set_gauge()
         args, kwargs = cloudpickle.loads(args_blob)
-        self._inflight += 1
         try:
             fn = self.callable if method == "__call__" else \
                 getattr(self.callable, method)
@@ -49,7 +87,9 @@ class Replica:
                     loop.close()
             return out
         finally:
-            self._inflight -= 1
+            with self._inflight_lock:
+                self._inflight -= 1
+            self._set_gauge()
 
     def queue_len(self) -> int:
         return self._inflight
@@ -74,7 +114,14 @@ class ServeController:
 
     def __init__(self, http_port: int = 0):
         self.deployments: Dict[str, dict] = {}   # name -> spec
-        self.replicas: Dict[str, List[Any]] = {}  # name -> actor handles
+        self.replicas: Dict[str, List[Any]] = {}  # name -> RUNNING handles
+        # DRAINING replicas: name -> [{"handle", "deadline", "zero_polls"}].
+        # Out of the routing table (generation bumped when they leave
+        # ``replicas``), killed once idle or past serve_drain_timeout_s.
+        self._draining: Dict[str, List[dict]] = {}
+        # Routing-table generation per deployment: bumped on ANY membership
+        # change of the RUNNING list so handles detect staleness cheaply.
+        self._generation: Dict[str, int] = {}
         # Replica lifecycle for the init-grace window: actor_id -> spawn
         # time; ids that have answered >=1 health ping.
         self._replica_started: Dict[Any, float] = {}
@@ -96,7 +143,8 @@ class ServeController:
                user_config=None, route_prefix: Optional[str] = None,
                max_concurrent_queries: int = 100,
                autoscaling: Optional[dict] = None,
-               init_grace_s: float = 120.0) -> bool:
+               init_grace_s: float = 120.0,
+               max_ongoing_requests: int = 0) -> bool:
         with self._lock:
             self.deployments[name] = {
                 "name": name, "cls_blob": cls_blob,
@@ -108,21 +156,86 @@ class ServeController:
                 "max_concurrent_queries": max_concurrent_queries,
                 "autoscaling": autoscaling,
                 "init_grace_s": init_grace_s,
+                "max_ongoing_requests": max_ongoing_requests,
             }
         self._reconcile_once()
         return True
 
+    @staticmethod
+    def _resolved_max_ongoing(spec: dict) -> int:
+        cap = int(spec.get("max_ongoing_requests") or 0)
+        if cap <= 0:
+            from ray_tpu import config
+            cap = int(config.get("serve_max_ongoing_requests"))
+        return max(1, cap)
+
+    def _bump_gen(self, name: str) -> None:
+        self._generation[name] = self._generation.get(name, 0) + 1
+
     def delete_deployment(self, name: str) -> bool:
-        import ray_tpu as rt
         with self._lock:
             self.deployments.pop(name, None)
-            dead = self.replicas.pop(name, [])
-        for a in dead:
-            try:
-                rt.kill(a)
-            except Exception:
-                pass
+        # Spec removed (route disappears at the next proxy refresh), then
+        # replicas leave the routing table and drain instead of dying with
+        # requests still on board.
+        with self._reconcile_lock:
+            current = self.replicas.pop(name, [])
+            if current:
+                self._bump_gen(name)
+            for a in current:
+                self._start_drain(name, a)
+            self._drain_tick()
         return True
+
+    def _start_drain(self, name: str, handle) -> None:
+        from ray_tpu import config
+        try:
+            fault_plane.fire("serve.replica.drain", deployment=name)
+        except Exception:
+            # An injected drain fault degrades to an immediate kill — the
+            # replica must still leave the cluster.
+            self._kill_replica(handle)
+            return
+        try:
+            from ray_tpu.util import events
+            events.emit("serve.drain", name)
+        except Exception:
+            pass
+        self._draining.setdefault(name, []).append({
+            "handle": handle,
+            "deadline": time.time() + float(
+                config.get("serve_drain_timeout_s")),
+            "zero_polls": 0,
+        })
+
+    def _drain_tick(self) -> None:
+        """Poll DRAINING replicas; kill the idle and the overdue ones."""
+        import ray_tpu as rt
+        for name in list(self._draining):
+            keep = []
+            for rec in self._draining[name]:
+                done = time.time() > rec["deadline"]
+                if not done:
+                    try:
+                        qlen = rt.get(rec["handle"].queue_len.remote(),
+                                      timeout=5)
+                        # Two consecutive idle polls: a request the handle
+                        # submitted just before the generation bump may not
+                        # have STARTED yet (inflight still 0 in the gap
+                        # between mailbox and execution).
+                        rec["zero_polls"] = rec["zero_polls"] + 1 \
+                            if qlen == 0 else 0
+                        done = rec["zero_polls"] >= 2
+                    except Exception:
+                        done = True   # unreachable/dead: nothing to drain
+                if done:
+                    self._kill_replica(rec["handle"])
+                else:
+                    keep.append(rec)
+            if keep:
+                self._draining[name] = keep
+            else:
+                del self._draining[name]
 
     def _kill_replica(self, handle) -> None:
         import ray_tpu as rt
@@ -149,13 +262,19 @@ class ServeController:
     def _spawn_replica(self, spec: dict):
         import ray_tpu as rt
         opts = dict(spec["ray_actor_options"])
+        max_ongoing = self._resolved_max_ongoing(spec)
         cls = rt.remote(Replica)
         handle = cls.options(
             num_cpus=opts.get("num_cpus", 1),
             num_tpus=opts.get("num_tpus", 0),
             resources=opts.get("resources", {}),
-            max_concurrency=spec["max_concurrent_queries"],
-        ).remote(spec["cls_blob"], spec["init_args_blob"])
+            # Concurrency must exceed the in-flight cap so the over-cap
+            # rejection path can actually run (a saturated thread pool
+            # would queue the probe call behind the work it should shed).
+            max_concurrency=max(spec["max_concurrent_queries"],
+                                max_ongoing + 2),
+        ).remote(spec["cls_blob"], spec["init_args_blob"],
+                 spec["name"], max_ongoing)
         self._replica_started[handle._rt_actor_id] = time.time()
         if spec.get("user_config") is not None:
             # The reconfigure wait covers __init__ too (the actor call
@@ -168,9 +287,9 @@ class ServeController:
         return handle
 
     def _reconcile_once(self) -> None:
-        import ray_tpu as rt
         with self._reconcile_lock:
             self._reconcile_locked()
+            self._drain_tick()
 
     def _reconcile_locked(self) -> None:
         import ray_tpu as rt
@@ -207,31 +326,80 @@ class ServeController:
                     self._kill_replica(a)
                 except Exception:
                     self._kill_replica(a)
+            if len(alive) != len(current):
+                self._bump_gen(name)
             current[:] = alive
             target = spec["num_replicas"]
+            if len(current) != target:
+                self._bump_gen(name)
             while len(current) < target:
                 current.append(self._spawn_replica(spec))
+            # Scale-down: newest replicas drain gracefully — they leave
+            # the routing table NOW (generation bumped above) but keep
+            # serving their in-flight requests until idle or the drain
+            # deadline.
             while len(current) > target:
-                self._kill_replica(current.pop())
+                self._start_drain(name, current.pop())
         # Lifecycle maps only ever track LIVE handles (scale-downs,
         # deletes, shutdowns all funnel through here eventually).
         live = {a._rt_actor_id for rs in self.replicas.values() for a in rs}
+        live |= {rec["handle"]._rt_actor_id
+                 for recs in self._draining.values() for rec in recs}
         for aid in [k for k in self._replica_started if k not in live]:
             self._replica_started.pop(aid, None)
         self._replica_ready &= live
 
     def _reconcile_loop(self) -> None:
+        # Two cadences: drain polling is latency-sensitive (an idle
+        # DRAINING replica should die within ~a second so scale-downs and
+        # deletes settle fast), while full reconcile + autoscale carry
+        # health-ping RPC fan-out and stay coarse.
+        tick = 0
         while not self._stopped:
-            time.sleep(2.0)
+            time.sleep(0.5)
+            tick += 1
             try:
-                self._reconcile_once()
-                self._autoscale()
+                if tick % 4 == 0:
+                    self._reconcile_once()   # includes a drain tick
+                    self._autoscale()
+                else:
+                    with self._reconcile_lock:
+                        self._drain_tick()
             except Exception:
                 pass
 
+    # -- autoscaling ------------------------------------------------------
+    @staticmethod
+    def _metrics_ongoing(name: str) -> Optional[float]:
+        """Total in-flight requests for a deployment, summed from the
+        replica-shipped ``rt_serve_replica_ongoing`` gauges in the
+        conductor metrics KV (the r10 plane). None when no replica has
+        shipped a snapshot yet — the caller falls back to RPC polling."""
+        import pickle
+        try:
+            from ray_tpu.core.api import _global_runtime
+            conductor = _global_runtime().conductor
+            total, found = 0.0, False
+            for key in conductor.call("kv_keys", ns="metrics"):
+                blob = conductor.call("kv_get", ns="metrics", key=key)
+                if blob is None:
+                    continue
+                entry = pickle.loads(blob).get("rt_serve_replica_ongoing")
+                if not entry:
+                    continue
+                for tags, value in entry["points"]:
+                    if dict(tags).get("deployment") == name:
+                        total += value
+                        found = True
+            return total if found else None
+        except Exception:
+            return None
+
     def _autoscale(self) -> None:
         """Queue-length autoscaling (parity: autoscaling_policy.py — scale
-        to total_queue_len / target_ongoing_requests, clamped)."""
+        to total_ongoing / target_ongoing_requests, clamped). Load comes
+        from the metrics registry the replicas already ship to; the
+        queue_len RPC fan-out remains only as the cold-start fallback."""
         import ray_tpu as rt
         with self._lock:
             specs = dict(self.deployments)
@@ -242,22 +410,39 @@ class ServeController:
             replicas = self.replicas.get(name, [])
             if not replicas:
                 continue
-            try:
-                qlens = rt.get([r.queue_len.remote() for r in replicas],
-                               timeout=15)
-            except Exception:
-                continue
+            total = self._metrics_ongoing(name)
+            if total is None:
+                try:
+                    total = sum(rt.get(
+                        [r.queue_len.remote() for r in replicas],
+                        timeout=15))
+                except Exception:
+                    continue
             target_ongoing = cfg.get("target_num_ongoing_requests", 2)
             desired = max(cfg.get("min_replicas", 1),
                           min(cfg.get("max_replicas", 10),
-                              -(-sum(qlens) // target_ongoing) or 1))
+                              -(-int(total) // target_ongoing) or 1))
             if desired != spec["num_replicas"]:
                 with self._lock:
-                    self.deployments[name]["num_replicas"] = desired
+                    if name in self.deployments:
+                        self.deployments[name]["num_replicas"] = desired
 
     # -- routing ---------------------------------------------------------
     def get_replicas(self, name: str) -> List[Any]:
         return list(self.replicas.get(name, []))
+
+    def get_routing(self, name: str) -> dict:
+        """Routing view for handles: RUNNING replicas only (DRAINING ones
+        are already gone), the table generation (staleness check), and the
+        per-replica in-flight cap."""
+        with self._lock:
+            spec = self.deployments.get(name)
+        max_ongoing = self._resolved_max_ongoing(spec) if spec else 0
+        return {
+            "replicas": list(self.replicas.get(name, [])),
+            "generation": self._generation.get(name, 0),
+            "max_ongoing": max_ongoing,
+        }
 
     def get_deployment_names(self) -> List[str]:
         with self._lock:
@@ -268,13 +453,29 @@ class ServeController:
             return {spec["route_prefix"] or f"/{name}": name
                     for name, spec in self.deployments.items()}
 
+    def draining_count(self) -> int:
+        return sum(len(v) for v in self._draining.values())
+
     def status(self) -> Dict[str, dict]:
         with self._lock:
-            return {name: {
+            out = {name: {
                 "num_replicas_target": spec["num_replicas"],
                 "num_replicas_running": len(self.replicas.get(name, [])),
+                "num_replicas_draining": len(self._draining.get(name, [])),
                 "route_prefix": spec["route_prefix"],
             } for name, spec in self.deployments.items()}
+        # Deleted deployments linger while replicas drain (their spec is
+        # gone but the drain records are not) — status must show them
+        # until they disappear for real.
+        for name, recs in self._draining.items():
+            if name not in out and recs:
+                out[name] = {
+                    "num_replicas_target": 0,
+                    "num_replicas_running": 0,
+                    "num_replicas_draining": len(recs),
+                    "route_prefix": None,
+                }
+        return out
 
     def start_http(self, host: str, port: int) -> int:
         import ray_tpu as rt
@@ -287,11 +488,38 @@ class ServeController:
                                     timeout=60)
         return self.http_port
 
+    def http_stats(self) -> dict:
+        import ray_tpu as rt
+        if self.http_actor is None:
+            return {}
+        return rt.get(self.http_actor.stats.remote(), timeout=30)
+
+    def http_reconfigure(self, overrides: dict) -> dict:
+        """Forward live config overrides to the proxy process (value None
+        clears). The driver's own set_override only reaches processes
+        spawned afterwards; this is the path to an already-running
+        ingress."""
+        import ray_tpu as rt
+        if self.http_actor is None:
+            return {}
+        return rt.get(self.http_actor.reconfigure.remote(dict(overrides)),
+                      timeout=30)
+
     def graceful_shutdown(self) -> bool:
         import ray_tpu as rt
         self._stopped = True
         for name in list(self.deployments):
             self.delete_deployment(name)
+        # Bounded wait for drains to settle, then force whatever is left.
+        deadline = time.time() + 15.0
+        while self.draining_count() and time.time() < deadline:
+            time.sleep(0.2)
+            with self._reconcile_lock:
+                self._drain_tick()
+        for recs in self._draining.values():
+            for rec in recs:
+                self._kill_replica(rec["handle"])
+        self._draining.clear()
         if self.http_actor is not None:
             try:
                 rt.kill(self.http_actor)

@@ -7,6 +7,14 @@ while matching the ASGI proxy's operational shape: one event loop, many
 concurrent in-flight requests (each deployment call runs in an executor so
 the loop never blocks), keep-alive connections, and chunked
 Transfer-Encoding for streaming responses (serve.StreamingResponse).
+
+Admission control (parity: the proxy's backpressure +
+max_queued_requests): each deployment gets a queue budget
+(serve_max_queued_requests) and an ongoing budget (replicas x
+serve_max_ongoing_requests). Past the queue budget requests shed with
+503 + Retry-After instead of queueing unboundedly; admitted requests
+carry a deadline (serve_request_timeout_s) and time out with 504, the
+in-flight call cancelled rather than leaked.
 """
 
 from __future__ import annotations
@@ -14,7 +22,15 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
+import weakref
 from typing import Iterable, Optional
+
+# Proxies constructed in THIS process (in-process protocol tests; the
+# production path runs one per proxy actor process). The conftest hygiene
+# fixture asserts these are closed — a live proxy is a leaked event-loop
+# thread.
+_live_proxies: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class StreamingResponse:
@@ -34,10 +50,28 @@ class StreamingResponse:
         return (StreamingResponse, (self.chunks, self.content_type))
 
 
-def _http_error(code: int, msg: str) -> bytes:
+_REASONS = {400: "Bad Request", 404: "Not Found", 500: "Internal Error",
+            501: "Not Implemented", 503: "Service Unavailable",
+            504: "Gateway Timeout"}
+
+
+def _http_error(code: int, msg: str,
+                retry_after: Optional[int] = None) -> bytes:
     body = json.dumps({"error": msg}).encode()
-    return (f"HTTP/1.1 {code} Error\r\nContent-Type: application/json\r\n"
+    extra = f"Retry-After: {retry_after}\r\n" if retry_after is not None \
+        else ""
+    return (f"HTTP/1.1 {code} {_REASONS.get(code, 'Error')}\r\n"
+            f"Content-Type: application/json\r\n{extra}"
             f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+
+def _emit(kind: str, ident: str, value: float = 1.0, **attrs) -> None:
+    try:
+        from ray_tpu.util import events
+        events.emit(kind, ident, value=value,
+                    attrs=attrs if attrs else None)
+    except Exception:
+        pass
 
 
 class HTTPProxy:
@@ -52,7 +86,13 @@ class HTTPProxy:
         # calls saturating the default pool must never block routing
         self._route_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="serve-routes")
+        # Admission book, touched only on the loop thread: per-deployment
+        # {"queued": n, "ongoing": n}. Counters for stats()/acceptance.
+        self._adm: dict = {}
+        self._counts = {"served": 0, "shed": 0, "timeouts": 0, "errors": 0}
         self._loop = asyncio.new_event_loop()
+        self._server = None
+        self._closed = False
         self._started = threading.Event()
         self._boot_error: Optional[BaseException] = None
         self._host, self._want_port = host, port
@@ -62,15 +102,27 @@ class HTTPProxy:
         if not self._started.wait(10.0) or self._boot_error is not None:
             raise self._boot_error or RuntimeError(
                 "serve proxy failed to start within 10s")
+        _live_proxies.add(self)
+        try:
+            from ray_tpu.util import events
+            events.register_probe("serve.proxy", self._probe)
+        except Exception:
+            pass
+
+    def _probe(self) -> dict:
+        queued = sum(st["queued"] for st in self._adm.values())
+        ongoing = sum(st["ongoing"] for st in self._adm.values())
+        return {"rt_serve_queued": float(queued),
+                "rt_serve_ongoing": float(ongoing)}
 
     # -- event loop -------------------------------------------------------
     def _run_loop(self) -> None:
         asyncio.set_event_loop(self._loop)
 
         async def boot():
-            server = await asyncio.start_server(
+            self._server = await asyncio.start_server(
                 self._handle_conn, self._host, self._want_port)
-            self._port = server.sockets[0].getsockname()[1]
+            self._port = self._server.sockets[0].getsockname()[1]
 
         try:
             self._loop.run_until_complete(boot())
@@ -79,7 +131,15 @@ class HTTPProxy:
             self._started.set()
             return
         self._started.set()
-        self._loop.run_forever()
+        try:
+            self._loop.run_forever()
+        finally:
+            try:
+                if self._server is not None:
+                    self._server.close()
+                self._loop.close()
+            except Exception:
+                pass
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
@@ -132,6 +192,39 @@ class HTTPProxy:
             except Exception:
                 pass
 
+    # -- admission --------------------------------------------------------
+    def _adm_state(self, name: str) -> dict:
+        st = self._adm.get(name)
+        if st is None:
+            st = self._adm[name] = {"queued": 0, "ongoing": 0}
+        return st
+
+    @staticmethod
+    def _budget(name: str) -> int:
+        """Ongoing budget: what the replica set can actually absorb
+        (replicas x per-replica cap, from the handle's routing view).
+        Before the first refresh lands the single-replica default
+        applies — the first calls refresh it."""
+        from ray_tpu import config
+        from ray_tpu.serve.api import _handle_for
+        h = _handle_for(name)
+        cap = h._max_ongoing or int(config.get(
+            "serve_max_ongoing_requests"))
+        n = len(h._replicas)
+        return max(1, max(1, n) * max(1, cap))
+
+    def _reject(self, writer, name: str, code: int, msg: str,
+                t0: float) -> None:
+        kind = "shed" if code == 503 else \
+            "timeouts" if code == 504 else "errors"
+        self._counts[kind] += 1
+        if code == 503:
+            _emit("serve.shed", name)
+        _emit("serve.request", name, value=time.monotonic() - t0,
+              code=code, deployment=name)
+        writer.write(_http_error(
+            code, msg, retry_after=1 if code == 503 else None))
+
     async def _dispatch(self, method: str, target: str, body: bytes,
                         writer: asyncio.StreamWriter) -> None:
         path = target.split("?")[0]
@@ -171,19 +264,70 @@ class HTTPProxy:
             except json.JSONDecodeError:
                 args = (body,)
 
+        from ray_tpu import config
+        t0 = time.monotonic()
+        try:
+            from ray_tpu.cluster import fault_plane
+            fault_plane.fire("serve.proxy.admit", deployment=name,
+                             path=path)
+        except Exception:
+            self._reject(writer, name, 503, "admission rejected", t0)
+            return
+        st = self._adm_state(name)
+        if st["queued"] >= int(config.get("serve_max_queued_requests")):
+            self._reject(writer, name, 503,
+                         f"queue full for {name!r}", t0)
+            return
+        deadline = t0 + float(config.get("serve_request_timeout_s"))
+        # Queue for an ongoing slot. The loop is single-threaded, so the
+        # counters need no lock; check-then-act is atomic between awaits.
+        st["queued"] += 1
+        try:
+            while st["ongoing"] >= self._budget(name):
+                if time.monotonic() >= deadline:
+                    _emit("serve.timeout", name)
+                    self._reject(writer, name, 504,
+                                 "timed out waiting for capacity", t0)
+                    return
+                await asyncio.sleep(0.005)
+            st["ongoing"] += 1
+        finally:
+            st["queued"] -= 1
+
         def call_blocking():
-            import ray_tpu as rt
             from ray_tpu.serve.api import _handle_for
-            return rt.get(_handle_for(name).remote(*args, **kwargs),
-                          timeout=120)
+            return _handle_for(name).call(
+                *args,
+                timeout=max(0.05, deadline - time.monotonic()),
+                **kwargs)
 
         try:
             # executor offload: slow model calls never stall the loop —
             # other connections keep being served (the ASGI property)
             out = await self._loop.run_in_executor(None, call_blocking)
         except Exception as e:  # noqa: BLE001 - HTTP error surface
-            writer.write(_http_error(500, repr(e)))
+            from ray_tpu.core.exceptions import GetTimeoutError
+            from ray_tpu.serve.api import _retryable
+            from ray_tpu.serve.controller import ReplicaBusyError
+            if isinstance(e, GetTimeoutError):
+                # the in-flight call was cancelled by ServeCallRef
+                self._reject(writer, name, 504,
+                             "deployment call timed out", t0)
+            elif isinstance(e, (ReplicaBusyError, RuntimeError)) \
+                    or _retryable(e):
+                # _retryable covers the call that burned its one retry on
+                # a SECOND dying replica: the failure is the cluster's,
+                # not the request's — the client may retry (503), this is
+                # not a 500.
+                self._reject(writer, name, 503, repr(e), t0)
+            else:
+                self._reject(writer, name, 500, repr(e), t0)
             return
+        finally:
+            st["ongoing"] -= 1
+        self._counts["served"] += 1
+        _emit("serve.request", name, value=time.monotonic() - t0,
+              code=200, deployment=name)
         if isinstance(out, StreamingResponse):
             writer.write((
                 "HTTP/1.1 200 OK\r\n"
@@ -208,7 +352,6 @@ class HTTPProxy:
         """NON-BLOCKING cache read: returns the current table immediately;
         a stale table kicks off (at most one) background refresh on the
         dedicated route thread. Callers on the event loop never wait."""
-        import time
         with self._routes_lock:
             stale = time.monotonic() - self._routes_ts > 1.0
             if stale and not self._routes_refreshing:
@@ -218,8 +361,6 @@ class HTTPProxy:
 
     def _fetch_routes(self) -> dict:
         """Blocking controller fetch (runs on the route thread only)."""
-        import time
-
         import ray_tpu as rt
         from ray_tpu.serve.controller import ServeController
         try:
@@ -262,3 +403,47 @@ class HTTPProxy:
 
     def port(self) -> int:
         return self._port
+
+    def reconfigure(self, overrides: dict) -> dict:
+        """Apply config overrides inside the proxy's process; a value of
+        None clears the override. Admission reads config at request time,
+        so operators can live-tune the ingress knobs (queue budget,
+        per-replica cap, deadline) without bouncing the listener and
+        dropping its keep-alive connections."""
+        from ray_tpu import config
+        for name, value in overrides.items():
+            if value is None:
+                config.clear_override(name)
+            else:
+                config.set_override(name, value)
+        return {k: config.get(k) for k in overrides}
+
+    def stats(self) -> dict:
+        """Admission counters + live occupancy (acceptance checks and the
+        controller's http_stats passthrough read these)."""
+        return {
+            "served": self._counts["served"],
+            "shed": self._counts["shed"],
+            "timeouts": self._counts["timeouts"],
+            "errors": self._counts["errors"],
+            "queued": sum(st["queued"] for st in self._adm.values()),
+            "ongoing": sum(st["ongoing"] for st in self._adm.values()),
+        }
+
+    def close(self) -> None:
+        """Stop the server and the loop thread (idempotent). In-process
+        protocol tests must call this; the actor path dies with its
+        process."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        except Exception:
+            pass
+        self._route_pool.shutdown(wait=False)
+        _live_proxies.discard(self)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

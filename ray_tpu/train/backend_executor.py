@@ -138,11 +138,12 @@ class BackendExecutor:
         self.slice_topology = slice_topology
         self.worker_group: Optional[WorkerGroup] = None
 
-    def start(self) -> None:
+    def start(self, ready_timeout: float = 120.0) -> None:
         try:
             self.worker_group = WorkerGroup(
                 self.num_workers, self.resources_per_worker,
-                self.placement_strategy, slice_topology=self.slice_topology)
+                self.placement_strategy, slice_topology=self.slice_topology,
+                ready_timeout=ready_timeout)
             self.backend.on_start(self.worker_group)
         except Exception as e:  # noqa: BLE001 - retryable via FailureConfig
             raise TrainingFailedError(f"gang formation failed: {e!r}") from e

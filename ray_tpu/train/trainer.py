@@ -194,7 +194,13 @@ class DataParallelTrainer(BaseTrainer):
                 return None
 
             try:
-                executor.start()
+                # First formation waits the full window (nodes may still be
+                # joining). On an elastic RESTART capacity just shrank, and
+                # the worker count was planned from a membership view that
+                # can lag the failure — an infeasible gang should fail fast
+                # and re-plan against the settled cluster, not park on the
+                # placement timeout.
+                executor.start(ready_timeout=15.0 if attempts else 120.0)
                 executor.run(self.train_loop_per_worker,
                              self.train_loop_config, on_report,
                              trial_dir=trial_dir,

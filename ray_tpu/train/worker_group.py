@@ -116,7 +116,8 @@ class WorkerGroup:
     """Driver-side handle over the gang (parity: worker_group.py:92)."""
 
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
-                 placement_strategy: str = "PACK", slice_topology: str = ""):
+                 placement_strategy: str = "PACK", slice_topology: str = "",
+                 ready_timeout: float = 120.0):
         import ray_tpu as rt
         from ray_tpu.util.placement_group import placement_group
         from ray_tpu.util.scheduling_strategies import (
@@ -131,7 +132,7 @@ class WorkerGroup:
         else:
             self.pg = placement_group(bundles, strategy=placement_strategy)
         try:
-            self.pg.ready(timeout=120)
+            self.pg.ready(timeout=ready_timeout)
             cls = rt.remote(RayTrainWorker)
             self.workers = []
             for rank in range(num_workers):

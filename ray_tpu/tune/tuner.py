@@ -396,12 +396,11 @@ class Tuner:
 
         # -- replay the journal (restore path; empty on a fresh run) ----
         suggested: List[tuple] = []          # (trial_id, config) in order
-        completed: Dict[str, dict] = {}
         for rec in ledger.load():
             if rec.get("event") == "suggest":
                 suggested.append((rec["trial_id"], rec["config"]))
-            elif rec.get("event") == "complete":
-                completed[rec["trial_id"]] = rec
+            # "complete" records are advisory: completion truth is the
+            # per-trial result.pkl (checked below), which lands first.
         # Search-state snapshot: resume the SAME search (rng position, TPE
         # observations, PBT population) instead of replaying suggest()
         # against a fresh searcher, which silently diverges the stream.
@@ -423,9 +422,13 @@ class Tuner:
                 # re-running suggest().
                 searcher.register_suggestion(trial_id, cfg)
                 seen.add(trial_id)
-            done = completed.get(trial_id)
-            payload = ledger.load_result(trial_id) if done else None
-            if done and payload is not None:
+            # result.pkl is the durable completion truth: it is written
+            # atomically (tmp + os.replace) BEFORE the journal "complete"
+            # record, and a driver killed between the two writes (the
+            # fsync can stall for seconds under I/O load) must not re-run
+            # the finished trial on restore.
+            payload = ledger.load_result(trial_id)
+            if payload is not None:
                 if trial_id not in completed_set:
                     searcher.on_trial_complete(trial_id, payload["metrics"])
                     completed_set.add(trial_id)

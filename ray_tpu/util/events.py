@@ -342,6 +342,34 @@ def _fold_metrics(evs: List[tuple], dropped: int) -> None:
             eff = a.get("efficiency")
             if eff is not None:
                 m.builtin(m.Gauge, "rt_pipeline_efficiency").set(eff)
+        elif kind == "serve.request":
+            # value = request latency (s); attrs carry the HTTP code.
+            a = attrs or {}
+            code = str(a.get("code", ""))
+            m.builtin(C, "rt_serve_requests_total",
+                      tag_keys=("code",)).inc(tags={"code": code})
+            m.builtin(H, "rt_serve_request_s",
+                      boundaries=[0.001, 0.005, 0.02, 0.1, 0.5, 2, 10, 60]
+                      ).observe(value)
+        elif kind == "serve.shed":
+            m.builtin(C, "rt_serve_shed_total").inc(value or 1)
+        elif kind == "serve.timeout":
+            m.builtin(C, "rt_serve_timeout_total").inc(value or 1)
+        elif kind == "serve.retry":
+            m.builtin(C, "rt_serve_retries_total").inc(value or 1)
+        elif kind == "serve.drain":
+            m.builtin(C, "rt_serve_drains_total").inc(value or 1)
+        elif kind == "serve.batch.flush":
+            # value = batch size; attrs carry the adaptive-window state.
+            a = attrs or {}
+            m.builtin(H, "rt_serve_batch_size",
+                      boundaries=[1, 2, 4, 8, 16, 32, 64, 128]
+                      ).observe(value)
+            if a.get("window_ms") is not None:
+                m.builtin(m.Gauge, "rt_serve_batch_window_ms").set(
+                    a["window_ms"])
+            if a.get("p99_ms") is not None:
+                m.builtin(m.Gauge, "rt_serve_p99_ms").set(a["p99_ms"])
     if dropped:
         m.builtin(C, "rt_events_dropped_total").inc(dropped)
 

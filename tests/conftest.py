@@ -37,11 +37,38 @@ def pytest_configure(config):
 
 @pytest.fixture(autouse=True)
 def _cgraph_hygiene(request):
-    """Compiled-graph teardown hygiene (compiled-dag and pipeline tests):
-    no test may leave a live CompiledGraph/CompiledPipeline (resident
-    loops still installed) or a leaked channel shm segment behind."""
+    """Leak hygiene after dag/pipeline/serve tests: no test may leave a
+    live CompiledGraph/CompiledPipeline (resident loops still installed),
+    a leaked channel shm segment, an unclosed in-process HTTP proxy (a
+    leaked event-loop thread), or DRAINING serve replicas that never
+    settle."""
     yield
     nodeid = request.node.nodeid
+    if "test_serve" in nodeid:
+        import time
+
+        from ray_tpu.serve import http_proxy
+        live = [p for p in http_proxy._live_proxies if not p.closed]
+        assert not live, f"test leaked live HTTP proxies: {live}"
+        from ray_tpu.core import api as core_api
+        if core_api._runtime is not None:
+            # A DRAINING replica must reach idle-kill or its deadline —
+            # one lingering forever means the drain state machine leaked.
+            try:
+                import ray_tpu
+                from ray_tpu.serve.controller import ServeController
+                ctrl = ray_tpu.get_actor(ServeController.CONTROLLER_NAME)
+            except Exception:
+                ctrl = None
+            if ctrl is not None:
+                deadline = time.monotonic() + 15.0
+                n = ray_tpu.get(ctrl.draining_count.remote(), timeout=15)
+                while n and time.monotonic() < deadline:
+                    time.sleep(0.2)
+                    n = ray_tpu.get(ctrl.draining_count.remote(),
+                                    timeout=15)
+                assert n == 0, \
+                    f"test leaked {n} DRAINING serve replicas"
     if ("test_compiled_dag" not in nodeid
             and "test_pipeline_train" not in nodeid):
         return
