@@ -22,8 +22,10 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu import config
 from ray_tpu.cluster import fault_plane
 from ray_tpu.cluster.protocol import RpcServer, get_client
+from ray_tpu.util import lockcheck
 
 # Actor FSM states (parity: gcs_actor_manager.h:249 state diagram).
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -64,10 +66,10 @@ class Conductor:
     """In-memory control-plane tables + schedulers, served over RpcServer."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 health_timeout_s: float = 10.0,
+                 health_timeout_s: Optional[float] = None,
                  persist_dir: Optional[str] = None):
         import uuid
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("conductor.state")
         self._cv = threading.Condition(self._lock)
         # Epoch: fresh per conductor process. Daemons and ref trackers
         # compare it on every exchange; a change means "the conductor
@@ -118,7 +120,9 @@ class Conductor:
         self._ring_events: List[dict] = []
         self._ring_dropped = 0
         self._job_counter = 0
-        self._health_timeout_s = health_timeout_s
+        self._health_timeout_s = (
+            health_timeout_s if health_timeout_s is not None
+            else float(config.get("health_check_timeout_s")))
         self._stopped = False
         # worker-log pubsub ring (log streaming to drivers / `job logs`).
         # Own CV: log polls must not wake on (or scan under) the global
@@ -1471,10 +1475,11 @@ class Conductor:
     # Task events / jobs (parity: gcs_task_manager.h:61, GcsJobManager)
     # ------------------------------------------------------------------
     def rpc_push_task_events(self, events: List[dict]) -> None:
+        cap = int(config.get("task_event_buffer_size"))
         with self._lock:
             self._task_events.extend(events)
-            if len(self._task_events) > 100_000:
-                del self._task_events[:len(self._task_events) - 100_000]
+            if len(self._task_events) > cap:
+                del self._task_events[:len(self._task_events) - cap]
 
     def rpc_get_task_events(self) -> List[dict]:
         with self._lock:

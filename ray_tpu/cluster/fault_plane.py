@@ -82,6 +82,68 @@ from typing import Any, Dict, List, Optional
 from ray_tpu import config
 
 
+# Canonical fault-site registry: every ``fire("…")`` literal in the tree
+# must be listed here (enforced by rtcheck's fault-sites checker, both
+# directions), ``load_plan`` validates rule sites against it, and the
+# ``ray_tpu fault-sites`` CLI prints it. The one-line doc says where the
+# site sits and what a fired rule models.
+SITES: Dict[str, str] = {
+    "rpc.server.dispatch": "server, before a handler runs (delay models "
+                           "a slow/overloaded server; subsumes "
+                           "testing_rpc_delay_us)",
+    "rpc.server.reply": "server, before the reply frame is written "
+                        "(drop_reply models a reply lost on the wire)",
+    "rpc.client.send": "client, before a request frame is written "
+                       "(sever cuts the connection mid-send)",
+    "rpc.client.recv": "client, while waiting for a reply frame "
+                       "(raise ConnectionLost models a dead peer)",
+    "conductor.journal.append": "conductor, before a journal record is "
+                                "appended (raise models journal-disk "
+                                "failure)",
+    "conductor.actor.schedule": "conductor, before an actor placement "
+                                "decision commits",
+    "conductor.location.add": "conductor, before an object location is "
+                              "recorded in the directory",
+    "daemon.worker.spawn": "daemon, before a worker process is forked "
+                           "(raise models spawn failure / OOM-killer)",
+    "daemon.lease.grant": "daemon, before a worker lease is granted",
+    "daemon.chunk.serve": "daemon, before a pull chunk is served from "
+                          "the local store",
+    "object.pull": "object plane, at pull start (raise fails the pull "
+                   "before any source is tried)",
+    "object.pull.window": "object plane, per pull window grant (delay "
+                          "models a saturated pull budget)",
+    "object.pull.chunk": "object plane, per fetched chunk (raise drives "
+                         "the source-failover path)",
+    "object.push.chunk": "push manager, per pushed chunk (raise models "
+                         "a failed push leg)",
+    "object.spill.write": "daemon, before a cold primary is written to "
+                          "the spill backend (raise keeps the shm copy)",
+    "object.spill.restore": "plane/daemon, before a spilled object is "
+                            "restored or served from its spill file "
+                            "(raise drives reconstruction)",
+    "object.evict": "daemon, before the shm copy of a spilled object is "
+                    "dropped (raise keeps dual copies)",
+    "worker.task.exec": "worker, before user task code runs (crash "
+                        "models mid-task preemption)",
+    "worker.actor.exec": "worker, before an actor method body runs",
+    "task.return.seal": "worker, before a task return is sealed into "
+                        "the store",
+    "task.reply.inline": "worker, before an inline (small) return rides "
+                         "the reply frame",
+    "cgraph.channel.write": "compiled graph, before a shm channel slot "
+                            "write",
+    "cgraph.loop.crash": "compiled graph, inside the per-actor exec "
+                         "loop (crash kills the pinned worker)",
+    "serve.proxy.admit": "HTTP proxy, before a request is admitted "
+                         "(raise sheds with 503)",
+    "serve.replica.call": "replica, before user handler code runs "
+                          "(crash is the chaos-SLO headline scenario)",
+    "serve.replica.drain": "controller, when a replica is marked "
+                           "DRAINING (raise degrades to immediate kill)",
+}
+
+
 class FaultInjected(Exception):
     """Default exception raised by a ``raise`` action."""
 
@@ -244,7 +306,25 @@ def fire(site: str, **ctx: Any) -> Optional[str]:
 
 def load_plan(rules: List[Dict[str, Any]], seed: int = 0) -> None:
     """Install a plan for this process AND (via config propagation) every
-    daemon/worker spawned afterwards."""
+    daemon/worker spawned afterwards. Rule sites must name a registered
+    fault point (exact match against ``SITES``, or an fnmatch pattern
+    matching at least one) — a typo'd site would otherwise arm a plan
+    that silently never fires. The ``unit.`` prefix is reserved for
+    tests that exercise the schedule machinery against synthetic
+    ``fire()`` calls."""
+    for spec in rules:
+        site = spec.get("site", "")
+        if site.startswith("unit."):
+            continue
+        if any(c in site for c in "*?["):
+            if not any(fnmatch.fnmatch(s, site) for s in SITES):
+                raise ValueError(
+                    f"fault_plan pattern {site!r} matches no registered "
+                    f"site (see fault_plane.SITES)")
+        elif site not in SITES:
+            raise ValueError(
+                f"fault_plan site {site!r} is not registered in "
+                f"fault_plane.SITES")
     config.set_override("fault_plan", json.dumps(rules))
     config.set_override("fault_seed", int(seed))
 

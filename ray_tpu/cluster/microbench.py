@@ -154,6 +154,41 @@ def main(argv=None) -> int:
     cli.close()
     srv.stop()
 
+    # -- correctness tooling (r15): both measured without a cluster ---
+    # rtcheck full-package scan: the tier-1 self-check runs this every
+    # suite invocation, so its wall time is a gated budget (<10s).
+    from ray_tpu.devtools.rtcheck import run_tree
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def rtcheck_scan():
+        run_tree([pkg_root])
+
+    per, _ = timed(rtcheck_scan, min_time=1.0 * scale)
+    results["rtcheck_full_tree_per_sec"] = round(1 / per, 2)
+
+    # NamedLock with the sanitizer armed, uncontended: the overhead every
+    # armed control-plane lock acquisition pays (held-stack push/pop).
+    from ray_tpu import config as _config
+    from ray_tpu.util import lockcheck
+
+    lockcheck.reset()
+    _config.set_override("lockcheck_enabled", True)
+    try:
+        bench_lock = lockcheck.named_lock("bench.uncontended")
+        n_lock = 20000
+
+        def lock_loop():
+            for _ in range(n_lock):
+                with bench_lock:
+                    pass
+
+        per, _ = timed(lock_loop, min_time=1.0 * scale)
+    finally:
+        _config.clear_override("lockcheck_enabled")
+        lockcheck.reset()
+    results["lock_uncontended_per_sec"] = round(n_lock / per, 1)
+
     # 1GB store: a realistic fraction of a TPU-host's RAM — the default
     # 256MB can hold only two 100MB bandwidth-test objects, so the loop
     # would measure spill I/O instead of the put path. 4 workers: enough
@@ -601,7 +636,8 @@ def main(argv=None) -> int:
             def one(i):
                 views[i] = planes[i].get_view(ref.id, timeout=60)
 
-            ts = [_threading.Thread(target=one, args=(i,))
+            ts = [_threading.Thread(target=one, args=(i,),
+                                    name=f"bench-pull-{i}", daemon=True)
                   for i in range(len(planes))]
             t0 = time.perf_counter()
             for t in ts:

@@ -30,6 +30,7 @@ from ray_tpu import config
 from ray_tpu.cluster import fault_plane, object_client
 from ray_tpu.cluster.protocol import RpcServer, get_client
 from ray_tpu.util import events as _events
+from ray_tpu.util import lockcheck
 
 CHUNK_SIZE = 8 << 20  # object transfer chunk (reference uses 5MiB chunks)
 
@@ -137,13 +138,14 @@ class _Worker:
         self.resources: Dict[str, float] = {}
         self.pg: Optional[Tuple[bytes, int]] = None
         self.actor_incarnation: int = -1
+        self.idle_since: Optional[float] = None  # set while pooled idle
 
 
 class NodeDaemon:
     def __init__(self, conductor_address: str,
                  resources: Optional[Dict[str, float]] = None,
                  host: str = "127.0.0.1",
-                 object_store_bytes: int = 1 << 30,
+                 object_store_bytes: Optional[int] = None,
                  is_head: bool = False,
                  session_dir: Optional[str] = None,
                  env_vars: Optional[Dict[str, str]] = None,
@@ -174,7 +176,7 @@ class NodeDaemon:
             resources.setdefault(gen_key, resources.get("TPU", 0.0))
         self.total_resources = dict(resources)
         self._avail = dict(resources)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("daemon.state")
         self._cv = threading.Condition(self._lock)
         self._owns_session_dir = session_dir is None
         self.session_dir = session_dir or tempfile.mkdtemp(prefix="rtpu-session-")
@@ -193,6 +195,9 @@ class NodeDaemon:
             self.session_dir, f"store-{self.node_id.hex()[:8]}.sock")
         spill_dir = os.path.join(self.session_dir, "spill")
         os.makedirs(spill_dir, exist_ok=True)
+        if object_store_bytes is None:
+            object_store_bytes = int(
+                config.get("object_store_memory_mb")) << 20
         self.store_proc = object_client.start_store(
             self.store_socket, object_store_bytes, self.store_prefix,
             spill_dir=spill_dir)
@@ -487,7 +492,7 @@ class NodeDaemon:
                                 pending_demand=demand,
                                 events=_events.heartbeat_payload())
             except Exception:
-                time.sleep(0.5)
+                time.sleep(float(config.get("health_check_period_s")))
                 continue
             epoch = resp.get("epoch")
             if resp.get("reregister") or (
@@ -520,7 +525,7 @@ class NodeDaemon:
                 except Exception:
                     pass
             self._flush_pending_death_reports(cli)
-            time.sleep(0.5)
+            time.sleep(float(config.get("health_check_period_s")))
 
     def _flush_pending_death_reports(self, cli) -> None:
         """Actor-death reports that failed (conductor downtime) retry on
@@ -749,6 +754,7 @@ class NodeDaemon:
                     cand = self._workers.get(token)
                     if cand is not None and cand.proc.poll() is None:
                         w = cand
+                        w.idle_since = None
                         break
             if w is None:
                 break
@@ -810,6 +816,7 @@ class NodeDaemon:
             w.pg = None
             pool = self._idle.setdefault(w.env_key, deque())
             if len(pool) < cap:
+                w.idle_since = time.monotonic()
                 pool.append(w.token)
                 return True
         self._kill_worker(w)
@@ -844,7 +851,10 @@ class NodeDaemon:
                 idle = len(self._idle.get("", ()))
                 cap = min(config.get("worker_pool_max_size"),
                           int(self.total_resources.get("CPU", 0)) or 1)
-                want = min(backlog - idle - self._prestarting,
+                # worker_pool_min_size keeps a warm floor of default-env
+                # workers independent of backlog (boot-time prestart).
+                floor = int(config.get("worker_pool_min_size"))
+                want = min(max(backlog, floor) - idle - self._prestarting,
                            cap - len(self._workers))
                 if want > 0:
                     self._prestarting += want
@@ -857,6 +867,7 @@ class NodeDaemon:
             w = self._spawn_worker("", None)
             if w.registered.wait(15.0) and w.proc.poll() is None:
                 with self._lock:
+                    w.idle_since = time.monotonic()
                     self._idle.setdefault("", deque()).append(w.token)
                 with self._cv:
                     self._cv.notify_all()
@@ -905,6 +916,30 @@ class NodeDaemon:
                     self.store.release(oid)
                 except Exception:
                     pass
+            # Idle-pool reaping: pooled workers idle past
+            # worker_idle_timeout_s are killed oldest-first, keeping the
+            # worker_pool_min_size warm floor in the default-env pool.
+            idle_timeout = float(config.get("worker_idle_timeout_s"))
+            expired: List[_Worker] = []
+            if idle_timeout > 0:
+                floor = int(config.get("worker_pool_min_size"))
+                with self._lock:
+                    now = time.monotonic()
+                    for env_key, q in self._idle.items():
+                        keep = floor if env_key == "" else 0
+                        while len(q) > keep:
+                            w = self._workers.get(q[0])
+                            if w is None:
+                                q.popleft()
+                                continue
+                            if w.idle_since is not None and \
+                                    now - w.idle_since > idle_timeout:
+                                q.popleft()
+                                expired.append(w)
+                            else:
+                                break  # leftmost is the longest-idle
+            for w in expired:
+                self._kill_worker(w)
             dead: List[_Worker] = []
             with self._lock:
                 for w in list(self._workers.values()):
@@ -1180,6 +1215,7 @@ class NodeDaemon:
         cli = get_client(self.conductor_address)
         deadline = time.monotonic() + 30.0
         if not reserved:
+            timed_out = False
             with self._cv:
                 while True:
                     a = avail_fn()
@@ -1188,16 +1224,22 @@ class NodeDaemon:
                         take(resources)
                         break
                     if time.monotonic() >= deadline:
-                        try:
-                            cli.call("actor_creation_failed",
-                                     actor_id=actor_id,
-                                     incarnation=incarnation,
-                                     error_blob=pickle.dumps(RuntimeError(
-                                         "insufficient resources for actor")))
-                        except Exception:
-                            pass
-                        return
+                        timed_out = True
+                        break
                     self._cv.wait(0.5)
+            if timed_out:
+                # The failure report is a conductor RPC (with reconnect
+                # retries) — it must run OUTSIDE the daemon state lock or
+                # a slow conductor freezes every lease/heartbeat path.
+                try:
+                    cli.call("actor_creation_failed",
+                             actor_id=actor_id,
+                             incarnation=incarnation,
+                             error_blob=pickle.dumps(RuntimeError(
+                                 "insufficient resources for actor")))
+                except Exception:
+                    pass
+                return
         from ray_tpu.core.exceptions import RuntimeEnvSetupError
         try:
             w = self._checkout_worker(

@@ -285,10 +285,13 @@ class ShmClient:
                 return  # socket gone; the daemon reaps on disconnect
 
     def _drain_releases(self) -> None:
+        # _lock IS the wire lock: it exists to serialize request/reply
+        # framing on this store socket, so socket I/O under it is the
+        # design, not a hazard (local unix socket, store replies are µs).
         with self._lock:
             while self._deferred_releases:
                 oid = self._deferred_releases.popleft()
-                self._sock.sendall(struct.pack(
+                self._sock.sendall(struct.pack(     # rtcheck: allow-blocking(wire lock: serializes framing on the local store socket)
                     "<IB16s", 17, OP_RELEASE, oid))
                 self._read_frame()
 
@@ -297,10 +300,10 @@ class ShmClient:
         with self._lock:
             while self._deferred_releases:
                 oid = self._deferred_releases.popleft()
-                self._sock.sendall(struct.pack(
+                self._sock.sendall(struct.pack(     # rtcheck: allow-blocking(wire lock: serializes framing on the local store socket)
                     "<IB16s", 17, OP_RELEASE, oid))
                 self._read_frame()
-            self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+            self._sock.sendall(struct.pack("<I", len(payload)) + payload)  # rtcheck: allow-blocking(wire lock: serializes framing on the local store socket)
             return self._read_frame()
 
     def _read_frame(self) -> bytes:
@@ -472,9 +475,9 @@ class ShmClient:
         with self._lock:
             while self._deferred_releases:
                 oid = self._deferred_releases.popleft()
-                self._sock.sendall(struct.pack("<IB16s", 17, OP_RELEASE, oid))
+                self._sock.sendall(struct.pack("<IB16s", 17, OP_RELEASE, oid))  # rtcheck: allow-blocking(wire lock: serializes framing on the local store socket)
                 self._read_frame()
-            self._sock.sendall(b"".join(frames))
+            self._sock.sendall(b"".join(frames))  # rtcheck: allow-blocking(wire lock: serializes framing on the local store socket)
             for _ in frames:
                 if self._read_frame()[0] == ST_OK:
                     wrote += 1

@@ -28,6 +28,7 @@ from ray_tpu.core import serialization
 from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
 from ray_tpu.core.ids import ObjectID, store_key
 from ray_tpu.util import events as _events
+from ray_tpu.util import lockcheck
 
 # Batch-get miss marker (a stored value may legitimately be None).
 MISS = object()
@@ -62,7 +63,8 @@ class _ByteBudget:
     def __init__(self, cap: int):
         self.cap = cap
         self._used = 0
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(
+            lockcheck.named_lock("plane.pull_budget"))
         self._queue: "deque[object]" = deque()
 
     def acquire(self, n: int) -> None:
@@ -188,7 +190,7 @@ class _LocationBatcher:
         self._conductor = conductor
         self._node_id = node_id
         self._buf: list = []    # (node_id, key) pairs, arrival order
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("plane.loc_batch")
         self._event = threading.Event()
         self._stopped = False
         self._drop_logged = False

@@ -105,6 +105,29 @@ def _uds_enabled() -> bool:
         return True
 
 
+_frame_cap_gen: Optional[int] = None
+_frame_cap_v = 0
+
+
+def _frame_cap() -> int:
+    """rpc_message_max_bytes, cached on the config generation (read per
+    received frame — too hot for a raw config.get)."""
+    global _frame_cap_gen, _frame_cap_v
+    from ray_tpu import config
+    if _frame_cap_gen != config.generation:
+        _frame_cap_v = int(config.get("rpc_message_max_bytes"))
+        _frame_cap_gen = config.generation
+    return _frame_cap_v
+
+
+def _connect_timeout() -> float:
+    from ray_tpu import config
+    try:
+        return float(config.get("rpc_connect_timeout_s"))
+    except Exception:
+        return 10.0
+
+
 class RpcError(Exception):
     pass
 
@@ -226,6 +249,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
 
 def _recv_frame(sock: socket.socket) -> bytearray:
     (length,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if length > _frame_cap():
+        # A corrupt/malicious length prefix must not allocate gigabytes;
+        # the connection is unrecoverable (stream offset is lost).
+        raise ConnectionLost(
+            f"frame length {length} exceeds rpc_message_max_bytes "
+            f"({_frame_cap()})")
     return _recv_exact(sock, length)
 
 
@@ -603,15 +632,25 @@ class RpcClient:
             if os.path.exists(path):
                 s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                 try:
-                    s.settimeout(self._timeout)
+                    s.settimeout(self._timeout if self._timeout is not None
+                                 else _connect_timeout())
                     s.connect(path)
+                    s.settimeout(self._timeout)
                     return s
                 except OSError:
                     try:
                         s.close()
                     except OSError:
                         pass
-        sock = socket.create_connection(self._target, timeout=self._timeout)
+        # Connection establishment is bounded by rpc_connect_timeout_s even
+        # when per-call timeouts are unbounded (a dead peer must not hang
+        # the caller in connect()); established-socket ops keep the
+        # caller's timeout semantics.
+        sock = socket.create_connection(
+            self._target,
+            timeout=self._timeout if self._timeout is not None
+            else _connect_timeout())
+        sock.settimeout(self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
@@ -678,7 +717,7 @@ class RpcClient:
             ok, payload = _loads_frame(_recv_frame(sock))
             if _timeout is not None:
                 sock.settimeout(self._timeout)
-        except BaseException as e:
+        except BaseException as e:  # noqa: BLE001 - socket is poisoned either way; classified and re-raised below
             try:
                 sock.close()
             except OSError:

@@ -109,7 +109,8 @@ class TaskEventLog:
         self._cli = get_client(conductor_address)
         self._node_hex = node_id.hex()
         self._pid = pid
-        self._flusher = threading.Thread(target=self._loop, daemon=True)
+        self._flusher = threading.Thread(target=self._loop, daemon=True,
+                                         name="task-event-flusher")
         self._flusher.start()
 
     def record(self, task_id: bytes, name: str, kind: str,
@@ -340,7 +341,7 @@ class WorkerService:
         for i in range(num_returns):
             try:
                 self._emit_return(tid.object_id_for_return(i), err, collect)
-            except BaseException:
+            except BaseException:  # noqa: BLE001 - fallback error report; caller must unblock
                 # The error object itself failed to serialize/store: fall
                 # back to a bare TaskError so the caller still unblocks.
                 self._emit_return(tid.object_id_for_return(i),

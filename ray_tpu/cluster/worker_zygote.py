@@ -50,7 +50,7 @@ def _parent_watchdog(sock_path: str) -> None:
                 os.unlink(sock_path)
             except OSError:
                 pass
-            os._exit(0)
+            os._exit(0)  # rtcheck: allow-exit(orphaned zygote: parent daemon died, nothing to clean up)
 
 
 def main() -> None:
@@ -125,6 +125,7 @@ def main() -> None:
                     import traceback   # return into the accept loop
                     traceback.print_exc()
                 finally:
+                    # rtcheck: allow-exit(forked child: must not unwind into the zygote accept loop)
                     os._exit(0)
             conn.sendall(json.dumps({"pid": pid}).encode() + b"\n")
         except Exception:

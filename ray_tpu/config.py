@@ -141,9 +141,6 @@ define("object_spill_dir", str, "",
        "bucket) is what lets spill copies outlive the node that wrote "
        "them: on holder death the conductor still advertises the URL and "
        "any node restores from it (local_object_manager.h role).")
-define("object_store_eviction_watermark", float, 0.8,
-       "Fraction of store capacity above which LRU eviction of unreferenced "
-       "sealed objects begins.")
 define("object_store_spill_threshold", float, 0.8,
        "Store-usage fraction past which the node daemon proactively "
        "spills cold unreferenced sealed primaries through the spill "
@@ -200,10 +197,9 @@ define("object_pull_shm_direct", bool, True,
        "exercise the chunked TCP path disable this.")
 define("lease_reuse_enabled", bool, True,
        "Reuse a granted worker lease for queued tasks with the same scheduling "
-       "key (the reference's lease-reuse fast path, direct_task_transport.cc).")
-define("scheduler_spread_threshold", float, 0.5,
-       "Hybrid policy: prefer local node until its critical-resource "
-       "utilization exceeds this fraction, then best-score remote.")
+       "key (the reference's lease-reuse fast path, direct_task_transport.cc). "
+       "Off = every task pays a fresh grant; kept as the no-reuse "
+       "regression baseline for benchmarks.")
 define("max_pending_lease_requests", int, 10, "In-flight lease requests per key.")
 define("actor_start_pool_size", int, 8,
        "Bounded pool of concurrent actor bring-ups per node daemon: a wave "
@@ -225,8 +221,13 @@ define("lease_multi_grant", int, 4,
        "queue needs pool growth (1 = single-grant behavior).")
 
 # Health / fault tolerance
-define("health_check_period_s", float, 1.0, "Conductor -> node liveness ping period.")
-define("health_check_timeout_s", float, 10.0, "Misses before a node is marked dead.")
+define("health_check_period_s", float, 0.5,
+       "Node -> conductor heartbeat period (node_daemon._heartbeat_loop); "
+       "also the retry backoff when the conductor is unreachable.")
+define("health_check_timeout_s", float, 10.0,
+       "Silence window after which the conductor marks a node dead "
+       "(Conductor health_timeout_s default; callers may override per "
+       "instance).")
 define("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
 define("max_lineage_bytes", int, 256 * 1024 * 1024,
        "Byte budget for retained task lineage (args blobs) per submitter; "
@@ -336,7 +337,9 @@ define("tpu_probe_timeout_s", float, 20.0,
        "backend degrades to 0 chips instead of hanging init().")
 
 # Observability
-define("task_event_buffer_size", int, 65536, "Task lifecycle events retained.")
+define("task_event_buffer_size", int, 100_000,
+       "Task lifecycle events the conductor retains (oldest dropped "
+       "first; state.list_tasks / dashboard timeline source).")
 define("tracing_enabled", bool, False,
        "Record OTel-style spans around task submit/execute "
        "(util/tracing.py; read via state.list_spans).")
@@ -356,3 +359,14 @@ define("slow_op_threshold_s", float, 30.0,
        "Slow-op watchdog: a task/pull/RPC in flight longer than this "
        "emits a SLOW_OPERATION cluster event carrying the surrounding "
        "ring context. 0 disables.")
+define("lockcheck_enabled", bool, False,
+       "Lock-order sanitizer (util/lockcheck.py): named control-plane "
+       "locks record acquisition-order edges, flag cycles (potential "
+       "deadlock) and holds past lockcheck_hold_s into the flight "
+       "recorder. Disabled cost is one generation compare per acquire "
+       "(the fault_plane pattern); armed by conftest for the "
+       "conductor/daemon/serve test modules.")
+define("lockcheck_hold_s", float, 1.0,
+       "Lock-hold threshold for the sanitizer: a named lock held longer "
+       "than this emits a lock.long_hold event. 0 disables hold "
+       "tracking.")

@@ -196,7 +196,7 @@ def _ref_future(ref: ObjectRef):
         except BaseException as e:  # noqa: BLE001
             fut.set_exception(e)
 
-    threading.Thread(target=run, daemon=True).start()
+    threading.Thread(target=run, daemon=True, name="rt-kill-async").start()
     return fut
 
 

@@ -180,6 +180,7 @@ class TaskSubmitter:
                 "max_pending_lease_requests")
             self._default_max_retries = config.get(
                 "task_max_retries_default")
+            self._lease_reuse = config.get("lease_reuse_enabled")
             self._flags_gen = config.generation
 
     def submit(self, task: dict) -> None:
@@ -510,6 +511,16 @@ class TaskSubmitter:
 
     def _return_worker(self, st: _KeyState, w: _LeasedWorker) -> None:
         if not w.alive:
+            return
+        if not self._lease_reuse:
+            # lease_reuse_enabled=False: the no-reuse regression baseline —
+            # every task pays a fresh grant instead of picking up a
+            # lingering lease.
+            self.rt._release_lease(w)
+            with st.lock:
+                has_work = bool(st.queue)
+            if has_work:
+                self._pump(st)
             return
         with st.lock:
             w.idle_since = time.monotonic()
@@ -1577,7 +1588,8 @@ class ClusterRuntime:
             "methods": methods,
             "opts": {
                 "name": opts.name, "namespace": opts.namespace or self.namespace,
-                "max_restarts": opts.max_restarts,
+                "max_restarts": opts.max_restarts or int(
+                    config.get("actor_max_restarts_default")),
                 "max_task_retries": opts.max_task_retries,
                 "max_concurrency": opts.max_concurrency,
                 "lifetime": opts.lifetime,

@@ -219,7 +219,7 @@ class LocalRuntime:
         try:
             rargs, rkwargs = self._resolve_args(args, kwargs)
             instance = cls(*rargs, **rkwargs)
-        except BaseException:
+        except BaseException:  # noqa: BLE001 - undo name registration, then re-raised below
             if opts.name:
                 with self._lock:
                     if self._named_actors.get(key) == actor_id:

@@ -195,7 +195,7 @@ class CompiledGraph:
         self._multi_output = isinstance(root, MultiOutputNode)
         try:
             self._compile(root)
-        except BaseException:
+        except BaseException:  # noqa: BLE001 - cleanup then re-raise
             self._cleanup(best_effort=True)
             raise
         _live_graphs.add(self)
@@ -422,7 +422,7 @@ class CompiledGraph:
                 # should not stall while completed slots are waiting.
                 try:
                     self._pump_locked(until_seq=None, deadline=None)
-                except BaseException as e:
+                except BaseException as e:  # noqa: BLE001 - poison the graph then re-raise
                     self._poison(e)
                     raise
                 if self._inflight < self.max_in_flight:
@@ -445,7 +445,7 @@ class CompiledGraph:
                 _write_slot(w, seq, blob, flags,
                             timeout=max(0.05, deadline - time.monotonic()),
                             role="driver")
-        except BaseException as e:
+        except BaseException as e:  # noqa: BLE001 - poison the graph then re-raise
             self._poison(e)
             raise
         _events().emit("cgraph.execute", self._gid.hex()[:16],
@@ -511,7 +511,7 @@ class CompiledGraph:
                     raise GetTimeoutError(
                         f"compiled-graph result {seq} not ready within "
                         f"{timeout}s") from None
-                except BaseException as e:
+                except BaseException as e:  # noqa: BLE001 - poison the graph then re-raise
                     self._poison(e)
                     raise
             vals = self._results.pop(seq)
@@ -541,7 +541,7 @@ class CompiledGraph:
                 return True   # "ready" in the sense that get() won't block
             try:
                 self._pump_locked(until_seq=None, deadline=None)
-            except BaseException as e:
+            except BaseException as e:  # noqa: BLE001 - poison the graph; get() surfaces it
                 self._poison(e)
                 return True
             return seq in self._results

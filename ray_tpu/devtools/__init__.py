@@ -1,0 +1,4 @@
+"""Developer tooling that ships with the runtime (static analysis,
+registries introspection). Nothing here is imported by production code
+paths; tier-1 tests run the checkers over the tree so every PR is gated
+without external CI."""

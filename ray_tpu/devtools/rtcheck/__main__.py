@@ -1,0 +1,3 @@
+from ray_tpu.devtools.rtcheck.core import main
+
+raise SystemExit(main())

@@ -196,6 +196,21 @@ def cmd_debug_state(args) -> None:
     print(json.dumps(state.debug_state(), indent=2, default=str))
 
 
+def cmd_fault_sites(args) -> None:
+    """`ray_tpu fault-sites`: the canonical fault-injection site registry
+    (cluster/fault_plane.py SITES). Plan files name these sites; rtcheck
+    enforces that the registry and the fire() call sites stay in sync."""
+    from ray_tpu.cluster.fault_plane import SITES
+    if args.json:
+        print(json.dumps(SITES, indent=2, sort_keys=True))
+        return
+    width = max(len(s) for s in SITES)
+    for site in sorted(SITES):
+        print(f"{site:<{width}}  {SITES[site]}")
+    print(f"{len(SITES)} fault sites (inject with a chaos plan: "
+          f"RAY_TPU_CHAOS_PLAN=plan.json)")
+
+
 def cmd_microbenchmark(args) -> None:
     from ray_tpu.cluster.microbench import run_microbenchmark
     addr = getattr(args, "address", None)
@@ -350,6 +365,11 @@ def main(argv=None) -> None:
         if name == "timeline":
             p.add_argument("--output", default=None)
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser("fault-sites",
+                       help="list registered fault-injection sites")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_fault_sites)
 
     p = sub.add_parser("job", help="submit and manage jobs")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

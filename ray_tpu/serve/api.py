@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional
 import cloudpickle
 
 from ray_tpu.core.refs import ChannelResolvedRef
+from ray_tpu.util import lockcheck
 
 
 def _get_controller(create: bool = True):
@@ -132,7 +133,7 @@ class DeploymentHandle:
         self._generation = -1
         self._max_ongoing = 0
         self._ts = 0.0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("serve.handle")
         self._inflight: Dict[Any, int] = {}
         # Evicted-replica quarantine: actor_id -> routing generation at
         # eviction time. The controller's table lags a death by up to a
@@ -162,9 +163,15 @@ class DeploymentHandle:
             if not force and time.monotonic() - self._ts < 1.0 \
                     and self._replicas:
                 return
-            controller = _get_controller(create=False)
-            routing = rt.get(
-                controller.get_routing.remote(self.name), timeout=30)
+        # The routing fetch is a controller round-trip (30s timeout) and
+        # must NOT run under the handle lock: concurrent requests keep
+        # routing on the previous table instead of convoying behind one
+        # refresher. Concurrent fetches are benign — the newest table
+        # wins and the generation compare below de-dups the bookkeeping.
+        controller = _get_controller(create=False)
+        routing = rt.get(
+            controller.get_routing.remote(self.name), timeout=30)
+        with self._lock:
             gen = routing["generation"]
             self._suspects = {k: g for k, g in self._suspects.items()
                               if g == gen}

@@ -16,6 +16,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.cluster import fault_plane
+from ray_tpu.util import lockcheck
 
 
 class ReplicaBusyError(Exception):
@@ -126,15 +127,16 @@ class ServeController:
         # time; ids that have answered >=1 health ping.
         self._replica_started: Dict[Any, float] = {}
         self._replica_ready: set = set()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("serve.controller")
         # serializes reconcile passes (deploy() and the loop both enter;
         # the controller actor itself runs with max_concurrency > 1)
-        self._reconcile_lock = threading.Lock()
+        self._reconcile_lock = lockcheck.named_lock("serve.reconcile")
         self._stopped = False
         self.http_port = http_port
         self.http_actor = None
         self._reconciler = threading.Thread(target=self._reconcile_loop,
-                                            daemon=True)
+                                            daemon=True,
+                                            name="serve-reconciler")
         self._reconciler.start()
 
     # -- deployment management ------------------------------------------

@@ -203,10 +203,17 @@ def _probe_chip_count(timeout_s: float) -> int:
 
 def local_chip_count() -> int:
     global _chip_count_cache
+    from ray_tpu import config
+    override = int(config.get("tpu_chips_per_host_override"))
+    if override > 0:
+        return override
     if platform_pinned_off_tpu():
-        return 0
+        # tpu_force_host_platform: a virtual mesh test wants the CPU
+        # devices counted as the TPU plane even though the process is
+        # pinned off the chip.
+        if not config.get("tpu_force_host_platform"):
+            return 0
     if _chip_count_cache is None:
-        from ray_tpu import config
         _chip_count_cache = _probe_chip_count(
             config.get("tpu_probe_timeout_s"))
     return _chip_count_cache

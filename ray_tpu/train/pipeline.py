@@ -348,7 +348,7 @@ class CompiledPipeline:
         self._last_collect_t: Optional[float] = None
         try:
             self._build()
-        except BaseException:
+        except BaseException:  # noqa: BLE001 - cleanup then re-raise
             self._cleanup(best_effort=True)
             raise
         _live_graphs.add(self)
@@ -511,7 +511,7 @@ class CompiledPipeline:
                                     timeout=max(0.05, deadline -
                                                 time.monotonic()),
                                     role="driver")
-            except BaseException as e:
+            except BaseException as e:  # noqa: BLE001 - poison the pipeline then re-raise
                 if self._poison_error is None:
                     self._poison_error = e
                 raise

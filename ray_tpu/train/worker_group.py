@@ -146,7 +146,7 @@ class WorkerGroup:
                     scheduling_strategy=strategy,
                 ).remote(rank, num_workers, rank)
                 self.workers.append(w)
-        except BaseException:
+        except BaseException:  # noqa: BLE001 - tear down the half-formed gang, then re-raise
             # half-formed gang: kill any actors already created AND release
             # the PG, so a retry plans against clean capacity (zombie ranks
             # would double-book the bundles the conductor just returned)

@@ -47,6 +47,55 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu import config
 
+# Canonical registry of flight-recorder event kinds: every
+# ``emit("…")`` literal in the tree must be minted here (rtcheck's
+# name-drift checker enforces both directions; ``test.*`` kinds used by
+# the test suite live outside the scanned tree). The doc states what
+# ``value`` means for the kind.
+EVENT_KINDS: Dict[str, str] = {
+    # task plane
+    "task.submit": "value unused; ident = task id",
+    "task.exec": "value = execution seconds",
+    "task.reply": "value = end-to-end seconds",
+    "task.retry": "value = retries remaining",
+    "lease.grant": "value = lease latency seconds",
+    "actor.window": "value = ordered-push window occupancy",
+    "inline.seal": "value = sealed inline bytes",
+    # rpc plane
+    "rpc.frame": "value = frame round-trip seconds; attrs carry bytes",
+    # object plane
+    "pull.window": "value = window bytes granted",
+    "pull.chunk": "value = chunk bytes fetched",
+    "pull.done": "value = total pulled bytes",
+    "pull.failover": "value = failed-source ordinal",
+    "pull.shm_direct": "value = bytes served shm-direct",
+    "push.chunk": "value = chunk bytes pushed",
+    "object.put.backpressure": "value = delay seconds",
+    "inline.hit": "value = inline bytes served from cache",
+    "inline.miss": "value unused; ident = object id",
+    # spill / evict tier
+    "object.spill.write": "value = bytes spilled",
+    "object.spill.restore": "value = bytes restored",
+    "object.evict": "value = shm bytes evicted",
+    # compiled graphs
+    "cgraph.execute": "value = execution seconds",
+    "cgraph.slot.write": "value = slot write seconds",
+    "cgraph.slot.wait": "value = reader-blocked seconds",
+    "pipeline.stage.op": "value = stage op seconds",
+    "pipeline.step": "value = step seconds",
+    # serve ingress
+    "serve.request": "value = request seconds",
+    "serve.shed": "value unused; attrs carry reason",
+    "serve.timeout": "value = deadline seconds",
+    "serve.retry": "value = attempt ordinal",
+    "serve.drain": "value = drained ongoing count",
+    "serve.batch.flush": "value = batch size; attrs carry window",
+    # infrastructure
+    "fault.fired": "value unused; ident = site, attrs carry action",
+    "lock.cycle": "value unused; attrs carry the lock cycle",
+    "lock.long_hold": "value = hold seconds; ident = lock name",
+}
+
 _lock = threading.Lock()
 _buf: List[Any] = []
 _cap = 0
@@ -359,6 +408,10 @@ def _fold_metrics(evs: List[tuple], dropped: int) -> None:
             m.builtin(C, "rt_serve_retries_total").inc(value or 1)
         elif kind == "serve.drain":
             m.builtin(C, "rt_serve_drains_total").inc(value or 1)
+        elif kind == "lock.cycle":
+            m.builtin(C, "rt_lock_cycles_total").inc()
+        elif kind == "lock.long_hold":
+            m.builtin(C, "rt_lock_long_holds_total").inc()
         elif kind == "serve.batch.flush":
             # value = batch size; attrs carry the adaptive-window state.
             a = attrs or {}

@@ -36,16 +36,99 @@ def _kv_key() -> bytes:
 
 _builtin_lock = threading.Lock()
 
+# Canonical registry of built-in runtime metric names (the ``rt_`` prefix
+# is reserved). ``builtin()`` refuses unminted rt_* names, and rtcheck's
+# name-drift checker enforces the same invariant statically: every rt_*
+# literal in the tree must appear here, and every entry here must be
+# referenced somewhere outside this module.
+METRICS: Dict[str, str] = {
+    # task plane
+    "rt_tasks_submitted_total": "tasks submitted by this driver",
+    "rt_tasks_executed_total": "tasks executed by this worker",
+    "rt_task_exec_s": "task execution wall time",
+    "rt_task_replies_total": "task replies observed by the driver",
+    "rt_task_retries_total": "task retries scheduled after failures",
+    "rt_lease_latency_s": "worker-lease grant latency",
+    "rt_actor_push_window": "actor ordered-push window occupancy",
+    # rpc plane
+    "rt_rpc_frame_latency_s": "rpc frame round-trip latency",
+    "rt_rpc_frames_total": "rpc frames sent",
+    "rt_rpc_frame_bytes_total": "rpc frame payload bytes",
+    "rt_rpc_inflight": "rpc requests currently in flight",
+    "rt_rpc_channels": "open rpc channels in this process",
+    # object plane
+    "rt_pull_windows_total": "pull windows granted",
+    "rt_pull_bytes_total": "bytes fetched by pulls",
+    "rt_pull_failovers_total": "pull chunk failovers to another source",
+    "rt_pull_shm_direct_total": "pulls satisfied shm-direct (same host)",
+    "rt_pull_inflight_bytes": "bytes currently in flight across pulls",
+    "rt_pull_budget_waiters": "pulls waiting on the inflight budget",
+    "rt_push_bytes_total": "bytes pushed by the push manager",
+    "rt_put_backpressure_total": "puts delayed by store backpressure",
+    "rt_inline_cache_hits_total": "inline (small-object) cache hits",
+    "rt_inline_cache_misses_total": "inline cache misses",
+    "rt_inline_cache_entries": "inline cache entries resident",
+    "rt_inline_cache_bytes": "inline cache bytes resident",
+    "rt_inline_pending_returns": "inline returns awaiting seal",
+    "rt_inline_seals_total": "inline returns sealed",
+    "rt_location_batch_backlog": "location-update batches queued",
+    # spill / evict tier
+    "rt_spill_objects_total": "primaries spilled to the durable tier",
+    "rt_spill_bytes_total": "bytes spilled to the durable tier",
+    "rt_spill_restores_total": "objects restored from spill",
+    "rt_spill_restore_bytes_total": "bytes restored from spill",
+    "rt_spill_restored_objects": "objects currently restored from spill",
+    "rt_spill_restored_bytes": "bytes currently restored from spill",
+    "rt_evict_objects_total": "shm copies evicted after spill",
+    "rt_evict_bytes_total": "shm bytes evicted after spill",
+    # compiled graphs
+    "rt_cgraph_executes_total": "compiled-graph executions",
+    "rt_cgraph_slot_writes_total": "compiled-graph channel slot writes",
+    "rt_cgraph_slot_write_s": "channel slot write latency",
+    "rt_cgraph_slot_wait_s": "channel slot wait (reader blocked)",
+    # train pipeline
+    "rt_pipeline_steps_total": "pipeline steps completed",
+    "rt_pipeline_stage_ops_total": "pipeline stage ops executed",
+    "rt_pipeline_stage_op_s": "pipeline stage op wall time",
+    "rt_pipeline_efficiency": "pipeline efficiency (busy/total)",
+    # serve ingress
+    "rt_serve_requests_total": "serve requests admitted",
+    "rt_serve_request_s": "serve request end-to-end latency",
+    "rt_serve_shed_total": "serve requests shed (503)",
+    "rt_serve_timeout_total": "serve requests timed out",
+    "rt_serve_retries_total": "serve handle retries",
+    "rt_serve_drains_total": "replica graceful drains",
+    "rt_serve_batch_size": "adaptive-batch flush size",
+    "rt_serve_batch_window_ms": "adaptive-batch window",
+    "rt_serve_p99_ms": "proxy-observed p99 latency",
+    "rt_serve_queued": "proxy requests queued",
+    "rt_serve_ongoing": "proxy requests ongoing",
+    "rt_serve_replica_ongoing": "per-replica ongoing requests",
+    # infrastructure
+    "rt_faults_fired_total": "fault-plane rules fired",
+    "rt_events_dropped_total": "flight-recorder events dropped",
+    # lock sanitizer
+    "rt_lock_cycles_total": "lock-order cycles detected by lockcheck",
+    "rt_lock_long_holds_total": "lock holds past lockcheck_hold_s",
+}
+
 
 def builtin(cls, name: str, description: str = "", **kwargs) -> "Metric":
     """Get-or-create a built-in runtime metric by name (the flight
-    recorder folds ring events into these off the hot path)."""
+    recorder folds ring events into these off the hot path). rt_* names
+    must be minted in ``METRICS`` — drift between emit sites and the
+    registry is exactly what this and rtcheck's name-drift pass catch."""
     m = _registry.get(name)
     if m is None:
+        if name.startswith("rt_") and name not in METRICS:
+            raise ValueError(
+                f"built-in metric {name!r} is not minted in "
+                f"metrics.METRICS (rt_* names are reserved)")
         with _builtin_lock:
             m = _registry.get(name)
             if m is None:
-                m = cls(name, description, **kwargs)
+                m = cls(name, description or METRICS.get(name, ""),
+                        **kwargs)
     return m
 
 

@@ -236,7 +236,7 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     step_ids = _assign_step_ids(dag)
     try:
         out = _execute_durable(dag, workflow_id, step_ids, {}, input_value)
-    except BaseException:
+    except BaseException:  # noqa: BLE001 - durably mark FAILED, then re-raise
         _set_status(workflow_id, "FAILED", None)
         raise
     get_storage().put_bytes(f"{workflow_id}/output.pkl",
@@ -258,7 +258,7 @@ def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
         except BaseException as e:  # noqa: BLE001
             fut.set_exception(e)
 
-    threading.Thread(target=go, daemon=True).start()
+    threading.Thread(target=go, daemon=True, name="workflow-run-async").start()
     return fut
 
 

@@ -85,6 +85,36 @@ def _cgraph_hygiene(request):
     assert not leaked, f"test leaked channel shm segments: {leaked}"
 
 
+_LOCKCHECK_MODULES = ("test_cluster_runtime", "test_control_plane_fastpath",
+                      "test_chaos_plane", "test_serve", "test_cluster_events",
+                      "test_object_tiering", "test_oom_and_pull_admission")
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_arm(request):
+    """Arm the lock-order sanitizer (util/lockcheck.py) for the
+    conductor/daemon/serve-heavy modules: every named control-plane lock
+    records acquisition-order edges for the duration of the test, and a
+    detected cycle (potential deadlock) fails it here. Driver-side only —
+    the flag is set after init-time config snapshots, so spawned daemons
+    and workers run with the sanitizer off."""
+    nodeid = request.node.nodeid
+    if not any(m in nodeid for m in _LOCKCHECK_MODULES):
+        yield
+        return
+    from ray_tpu import config
+    from ray_tpu.util import lockcheck
+    lockcheck.reset()
+    config.set_override("lockcheck_enabled", True)
+    try:
+        yield
+    finally:
+        config.clear_override("lockcheck_enabled")
+        cycles = lockcheck.cycles()
+        lockcheck.reset()
+        assert not cycles, f"lock-order cycles detected: {cycles}"
+
+
 @pytest.fixture
 def chaos_seed():
     """Seed for a chaos schedule, printed so the exact run reproduces:
