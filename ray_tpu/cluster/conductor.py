@@ -89,6 +89,10 @@ class Conductor:
         self._actors: Dict[bytes, ActorInfo] = {}
         self._named_actors: Dict[Tuple[str, str], bytes] = {}
         self._object_locations: Dict[bytes, Set[bytes]] = defaultdict(set)
+        # oid -> device placement string for array objects whose producer
+        # was device-resident (r16): locate_object surfaces it so pullers
+        # sharing the producer's mesh can prefer a device-to-device source.
+        self._object_devices: Dict[bytes, str] = {}
         # oid -> (spill url, size). Survives the writing node's death —
         # that is the point: locate_object keeps advertising the URL so
         # any node restores from the durable copy instead of declaring
@@ -637,22 +641,27 @@ class Conductor:
             self._cv.notify_all()
 
     def rpc_add_object_locations(self, oids: List[bytes],
-                                 node_id: bytes) -> None:
+                                 node_id: bytes,
+                                 devices: Optional[List[str]] = None) -> None:
         """Bulk registration: a daemon replaying its store inventory after
         a conductor epoch change (persistence.py), or a plane's batched
         per-result registrations (object_plane._LocationBatcher). Same
         tombstone semantics as the single-oid path: a copy sealed after
-        its refcount hit zero is a leak — delete it at the source."""
+        its refcount hit zero is a leak — delete it at the source.
+        ``devices`` (parallel to ``oids``, r16) tags array objects with
+        their producer's device placement for locate_object."""
         fault_plane.fire("conductor.location.add", n=len(oids))
         with self._cv:
             info = self._nodes.get(node_id)
             addr = info["address"] if info and info["alive"] else None
-            for oid in oids:
+            for i, oid in enumerate(oids):
                 if oid in self._ref_tombstones:
                     if addr is not None:
                         self._enqueue_delete(addr, oid)
                     continue
                 self._object_locations[oid].add(node_id)
+                if devices and i < len(devices) and devices[i]:
+                    self._object_devices[oid] = devices[i]
                 self._lost_objects.discard(oid)
             self._cv.notify_all()
 
@@ -712,11 +721,12 @@ class Conductor:
                         "spilled": sp[0] if sp else None,
                         "spilled_size": sp[1] if sp else 0,
                         "lost": lost,
+                        "device": self._object_devices.get(oid, ""),
                     }
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return {"nodes": [], "spilled": None,
-                            "spilled_size": 0, "lost": False}
+                            "spilled_size": 0, "lost": False, "device": ""}
                 self._cv.wait(min(remaining, 1.0))
 
     def rpc_objects_exist(self, oids: List[bytes]) -> List[bool]:
@@ -822,6 +832,7 @@ class Conductor:
                 old = self._ref_tombstone_order.popleft()
                 self._ref_tombstones.discard(old)
             freed.append(k)
+            self._object_devices.pop(k, None)
             for n in self._object_locations.pop(k, ()):
                 info = self._nodes.get(n)
                 if info is not None and info["alive"]:
@@ -897,6 +908,7 @@ class Conductor:
             nodes = [self._nodes[n]["address"]
                      for n in self._object_locations.pop(oid, ())
                      if n in self._nodes and self._nodes[n]["alive"]]
+            self._object_devices.pop(oid, None)
             sp = self._object_spilled.pop(oid, None)
             self._lost_objects.discard(oid)
         if sp is not None:

@@ -141,6 +141,13 @@ SITES: Dict[str, str] = {
                           "(crash is the chaos-SLO headline scenario)",
     "serve.replica.drain": "controller, when a replica is marked "
                            "DRAINING (raise degrades to immediate kill)",
+    "object.array.export": "serialization, before an array buffer is "
+                           "exported zero-copy (raise falls back to the "
+                           "classic pickle path)",
+    "object.collective.bcast": "object plane, per broadcast tree leg "
+                               "(sever cuts that member's connection; "
+                               "the member re-stripes onto the classic "
+                               "pull path)",
 }
 
 

@@ -362,6 +362,20 @@ def main(argv=None) -> int:
 
         per, _ = timed(compiled_graph, min_time=2.0 * scale)
         results["compiled_graph_roundtrip_per_sec"] = round(1 / per, 1)
+
+        # -- r16: array value through the same compiled chain ---------
+        # A 512KB float32 rides each channel hop as an RTAR slot
+        # (FLAG_ARRAY): header + raw buffer, no pickle on either side.
+        arr512 = np.zeros(128 * 1024, dtype=np.float32)
+        assert ray_tpu.get(cg.execute(arr512),
+                           timeout=30).nbytes == arr512.nbytes  # warm
+
+        def compiled_graph_array():
+            out = ray_tpu.get(cg.execute(arr512), timeout=30)
+            assert out.nbytes == arr512.nbytes
+
+        per, _ = timed(compiled_graph_array, min_time=2.0 * scale)
+        results["channel_array_roundtrip_per_sec"] = round(1 / per, 1)
         cg.teardown()
         for s in (s1, s2):
             ray_tpu.kill(s._actor_handle)
@@ -661,6 +675,50 @@ def main(argv=None) -> int:
         dt = min(bcast_64mb() for _ in range(3))
         results["broadcast_64mb_4way_gb_per_sec"] = round(
             len(planes) * 0.064 / dt, 2)
+
+        # -- r16: device-native array plane ---------------------------
+        # Same-host array put/get on the RTAR fast path (header + raw
+        # buffer, single copy in, read-only view out) vs the classic
+        # pickle-5 path measured back to back as the same-day control.
+        settle()
+
+        def array_put_get():
+            out = ray_tpu.get(ray_tpu.put(big))
+            assert out.nbytes == big.nbytes
+
+        per, _ = timed(array_put_get, min_time=2.0 * scale, min_iters=2)
+        results["array_put_get_100mb_gb_per_sec"] = round(0.1 / per, 2)
+        config.set_override("array_zero_copy_enabled", False)
+        per, _ = timed(array_put_get, min_time=2.0 * scale, min_iters=2)
+        results["array_put_get_100mb_classic_gb_per_sec"] = round(
+            0.1 / per, 2)
+        config.clear_override("array_zero_copy_enabled")
+
+        # Coordinated broadcast tree (ObjectPlane.broadcast_object) to
+        # the same 4 peers the directory-driven broadcast above used:
+        # rounds of tree legs, each fresh holder serving the next wave.
+        settle()
+
+        def device_bcast() -> float:
+            ref = ray_tpu.put(big64)
+            members = [{"node_id": n.node_id, "address": n.address}
+                       for n in peers]
+            t0 = time.perf_counter()
+            res = rt.plane.broadcast_object(ref.id, members)
+            dt_ = time.perf_counter() - t0
+            assert len(res["ok"]) + len(res["fallback"]) == len(peers), res
+            key = rt.plane._key(ref.id)
+            for n in peers:
+                try:
+                    n.store.delete(key)
+                except Exception:
+                    pass
+            del ref
+            return dt_
+
+        dt = min(device_bcast() for _ in range(3))
+        results["device_broadcast_64mb_4way_gb_per_sec"] = round(
+            len(peers) * 0.064 / dt, 2)
 
         # -- object tiering: coordinated spill + restore (r12) --------
         # One 100MB primary is written through the node daemon's spill

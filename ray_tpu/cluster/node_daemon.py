@@ -203,6 +203,10 @@ class NodeDaemon:
             spill_dir=spill_dir)
         self.store = object_client.ShmClient(self.store_socket,
                                              self.store_prefix)
+        # Daemon-owned ObjectPlane for r16 broadcast legs (pull_object
+        # RPC); built lazily — most daemons never serve one.
+        self._bcast_plane = None
+        self._bcast_plane_lock = threading.Lock()
         # --- workers ---
         self._workers: Dict[str, _Worker] = {}     # token -> worker
         self._idle: Dict[str, deque] = {}          # env_key -> tokens
@@ -1426,6 +1430,51 @@ class NodeDaemon:
                 "served": self._served_chunks,
                 "shm_path": self.store._shm_path(oid)}
 
+    def rpc_pull_object(self, oid: bytes,
+                        sources: Optional[list] = None) -> dict:
+        """Pull one object into this node's store NOW (r16 broadcast leg:
+        the driver coordinates a tree of these, each member pulling from
+        the holder the schedule assigned via ``sources``). Falls back to
+        a directory locate when no sources are given or the assigned
+        source cannot serve. Reuses the plane's full windowed-pull
+        machinery — shm-direct same-host copies, striping, failover and
+        its fault sites all apply to a broadcast leg."""
+        plane = self._pull_plane()
+        if self.store.contains(oid):
+            return {"ok": True, "outcome": "local"}
+        outcome = "error"
+        if sources:
+            nodes = [{"node_id": s.get("node_id"), "address": s["address"]}
+                     for s in sources]
+            outcome = plane._pull_from(oid, nodes)
+            if outcome == "ok":
+                return {"ok": True, "outcome": "ok"}
+        try:
+            loc = get_client(self.conductor_address).call(
+                "locate_object", oid=oid, timeout=2.0)
+        except Exception:  # noqa: BLE001
+            return {"ok": False, "outcome": outcome}
+        nodes = [n for n in loc.get("nodes", ())
+                 if n["node_id"] != self.node_id]
+        if nodes:
+            outcome = plane._pull_from(oid, nodes)
+        if outcome != "ok" and loc.get("spilled"):
+            if plane._restore_spilled(oid, loc["spilled"],
+                                      int(loc.get("spilled_size") or 0)):
+                outcome = "ok"
+        return {"ok": outcome == "ok", "outcome": outcome}
+
+    def _pull_plane(self):
+        """Lazily-built daemon-owned ObjectPlane (broadcast legs only —
+        the daemon's normal serve path never needs one)."""
+        with self._bcast_plane_lock:
+            if self._bcast_plane is None:
+                from ray_tpu.cluster.object_plane import ObjectPlane
+                self._bcast_plane = ObjectPlane(
+                    self.store, self.node_id, self.conductor_address,
+                    daemon_address=self.address)
+            return self._bcast_plane
+
     def rpc_pin_object(self, oid: bytes) -> dict:
         """Hold a store reference on behalf of a same-host shm-direct
         puller, so the segment cannot be deleted or recycled while the
@@ -1867,6 +1916,10 @@ class NodeDaemon:
         self._stopped = True
         if self._oom_monitor is not None:
             self._oom_monitor.stop()
+        with self._bcast_plane_lock:
+            plane, self._bcast_plane = self._bcast_plane, None
+        if plane is not None:
+            plane.stop()
         with self._lock:
             pool, self._actor_start_pool = self._actor_start_pool, None
         if pool is not None:

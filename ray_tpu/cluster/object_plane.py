@@ -201,18 +201,25 @@ class _LocationBatcher:
 
     _MAX_BUFFER = 262_144  # registrations kept across a conductor outage
 
-    def add(self, key: bytes, node_id: Optional[bytes] = None) -> None:
+    def add(self, key: bytes, node_id: Optional[bytes] = None,
+            device: str = "") -> None:
         with self._lock:
-            self._buf.append((node_id or self._node_id, key))
+            self._buf.append((node_id or self._node_id, key, device))
         self._event.set()
 
     def _send(self, batch: list) -> None:
         by_node: Dict[bytes, list] = {}
-        for nid, key in batch:
-            by_node.setdefault(nid, []).append(key)
-        for nid, keys in by_node.items():
-            self._conductor.call("add_object_locations", oids=keys,
-                                 node_id=nid)
+        for nid, key, device in batch:
+            by_node.setdefault(nid, []).append((key, device))
+        for nid, entries in by_node.items():
+            keys = [k for k, _ in entries]
+            if any(d for _, d in entries):
+                self._conductor.call(
+                    "add_object_locations", oids=keys, node_id=nid,
+                    devices=[d for _, d in entries])
+            else:
+                self._conductor.call("add_object_locations", oids=keys,
+                                     node_id=nid)
 
     def _loop(self) -> None:
         backoff = self._WINDOW_S
@@ -359,7 +366,12 @@ class ObjectPlane:
         except object_client.ObjectStoreError as e:
             if "already exists" not in str(e):
                 raise
-        self._loc_batcher.add(key)
+        device = ""
+        if segments and serialization.is_array_blob(segments[0]):
+            hdr = serialization.array_header(segments[0])
+            device = hdr["device"] if hdr else ""
+            _events.emit("object.array.put", key.hex(), value=float(total))
+        self._loc_batcher.add(key, device=device)
         return total
 
     def put_blob(self, oid: ObjectID, blob: bytes) -> int:
@@ -1028,6 +1040,145 @@ class ObjectPlane:
     def free(self, oid: ObjectID) -> None:
         self.conductor.call("free_object", oid=self._key(oid))
 
+    # -- collective-backed broadcast (r16) -------------------------------
+    def broadcast_object(self, oid: ObjectID, members: List[dict]) -> dict:
+        """Spread one local object to ``members`` (daemon descriptors
+        {"node_id", "address"}) via a tree of coordinated pulls — the
+        gloo-style CPU-host collective over the pipelined RPC layer
+        (on-TPU meshes broadcast in-program via collectives.broadcast_from
+        and never hit this path). Each round every holder serves up to
+        ``array_bcast_fanout`` new members, so aggregate bandwidth scales
+        with the number of fresh copies instead of serializing N pulls
+        through the origin's NIC (reference: collective-backed GPU object
+        broadcast, python/ray/util/collective).
+
+        A member whose tree leg fails (injected sever, daemon hiccup) is
+        re-striped onto the classic directory-driven pull path — zero
+        loss, degraded speed. Returns
+        {"ok": [...], "fallback": [...], "failed": [...], "skipped": bool}
+        of member node_ids.
+        """
+        from ray_tpu import config
+        from ray_tpu.parallel import collectives
+
+        key = self._key(oid)
+        members = [m for m in members if m["node_id"] != self.node_id]
+        result = {"ok": [], "fallback": [], "failed": [], "skipped": False}
+        if not members:
+            return result
+        view = self._get_pinned_tolerant(key)
+        if view is None:
+            raise ObjectLostError(
+                oid.hex(), "broadcast root does not hold the object")
+        size = view.nbytes
+        del view
+        # Make sure the directory already knows the root's copy before any
+        # member's pull (or its classic fallback) does a locate round.
+        self._loc_batcher.flush()
+        if size < int(config.get("array_bcast_min_bytes")) \
+                or not self.daemon_address:
+            # Too small for tree coordination to beat N direct pulls (or
+            # no co-resident daemon to serve as rank-0 source): classic.
+            result["skipped"] = True
+            _events.emit("object.bcast.fallback", key.hex(),
+                         value=float(len(members)))
+            for m in members:
+                if self._bcast_member_pull(key, m, None):
+                    result["ok"].append(m["node_id"])
+                else:
+                    result["failed"].append(m["node_id"])
+            return result
+        leg_timeout = float(config.get("array_bcast_leg_timeout_s"))
+        fanout = int(config.get("array_bcast_fanout"))
+        # Rank 0 is the root (this plane's co-resident daemon shares its
+        # store, so it can serve the object); ranks 1..n are the members.
+        ranks = [{"node_id": self.node_id, "address": self.daemon_address}]
+        ranks.extend(members)
+        t0 = time.monotonic()
+        reached: Dict[int, bool] = {0: True}
+        fallback: List[int] = []
+        for legs in collectives.broadcast_rounds(len(ranks), fanout=fanout):
+            threads = []
+            outcomes: Dict[int, bool] = {}
+
+            def _leg(src: int, dst: int) -> None:
+                ok = False
+                try:
+                    cli = get_client(ranks[dst]["address"])
+                    # Legs ride the pipelined channel (call_async, single
+                    # attempt): a severed channel fails the future FAST
+                    # and the member re-stripes, instead of the pooled
+                    # call path's transparent reconnect masking the cut.
+                    fut = cli.call_async("pull_object", oid=key,
+                                         sources=[ranks[src]])
+                    act = fault_plane.fire(
+                        "object.collective.bcast", oid=key,
+                        src=ranks[src]["address"],
+                        dst=ranks[dst]["address"])
+                    if act == "sever":
+                        cli.sever_pipe()
+                    resp = fut.result(timeout=leg_timeout)
+                    ok = bool(resp.get("ok"))
+                except Exception:  # noqa: BLE001 - leg re-stripes below
+                    ok = False
+                outcomes[dst] = ok
+                if ok:
+                    _events.emit("object.bcast.leg", key.hex(),
+                                 value=float(size))
+
+            for src, dst in legs:
+                if not reached.get(src):
+                    # Upstream leg failed: this subtree re-stripes onto
+                    # the classic path instead of pulling from a source
+                    # that never got the object.
+                    outcomes[dst] = False
+                    continue
+                t = threading.Thread(target=_leg, args=(src, dst),
+                                     name="bcast-leg", daemon=True)
+                threads.append(t)
+                t.start()
+            for t in threads:
+                t.join()
+            for src, dst in legs:
+                if outcomes.get(dst):
+                    reached[dst] = True
+                else:
+                    fallback.append(dst)
+        for r, ok in reached.items():
+            if r and ok:
+                result["ok"].append(ranks[r]["node_id"])
+        if fallback:
+            _events.emit("object.bcast.fallback", key.hex(),
+                         value=float(len(fallback)))
+            for r in fallback:
+                if self._bcast_member_pull(key, ranks[r], None):
+                    result["fallback"].append(ranks[r]["node_id"])
+                else:
+                    result["failed"].append(ranks[r]["node_id"])
+        _events.emit("object.bcast.done", key.hex(),
+                     value=time.monotonic() - t0,
+                     attrs={"members": len(members), "bytes": size,
+                            "fallback": len(fallback)})
+        return result
+
+    def _bcast_member_pull(self, key: bytes, member: dict,
+                           sources: Optional[list]) -> bool:
+        """One member's directory-driven (classic) pull — the re-stripe
+        target for failed tree legs. Its own connection may be the severed
+        one, so retry once on a fresh channel before giving up."""
+        from ray_tpu import config
+        timeout = float(config.get("array_bcast_leg_timeout_s"))
+        for _ in range(2):
+            try:
+                resp = get_client(member["address"]).call(
+                    "pull_object", oid=key, sources=sources,
+                    _timeout=timeout)
+                if resp.get("ok"):
+                    return True
+            except Exception:  # noqa: BLE001
+                continue
+        return False
+
     # -- introspection ---------------------------------------------------
     def metrics_probe(self) -> Dict[str, float]:
         """Point-in-time gauges for the event flusher (registered via
@@ -1053,6 +1204,7 @@ class ObjectPlane:
             "rt_location_batch_backlog": float(loc_backlog),
             "rt_spill_restored_objects": float(self._restored_objects),
             "rt_spill_restored_bytes": float(self._restored_bytes),
+            "rt_array_pins_live": float(serialization.live_array_pins()),
         }
 
     def debug_state(self) -> dict:

@@ -159,6 +159,28 @@ define("object_spill_reconstruct_min_bytes", int, 0,
        "cost scales with size; re-execution does not). 0 = always "
        "restore when a spill copy exists.")
 
+# Device-native array objects (r16)
+define("array_zero_copy_enabled", bool, True,
+       "Serialize top-level numpy/jax arrays as a tiny RTAR header plus "
+       "the raw buffer (exported zero-copy via dlpack/PickleBuffer) "
+       "instead of pickling the payload; gets return read-only array "
+       "views over the pinned shm mapping. Off = the classic pickle-5 "
+       "path, byte-identical to pre-r16 blobs (regression baseline).")
+define("array_bcast_min_bytes", int, 1 << 20,
+       "Objects at least this large take the collective broadcast tree "
+       "(ObjectPlane.broadcast_object); smaller ones fall back to plain "
+       "consumer pulls — the tree's per-leg RPC coordination costs more "
+       "than it saves below this size.")
+define("array_bcast_fanout", int, 2,
+       "Branching factor of the broadcast tree: each round, every holder "
+       "feeds up to this many new members (2 = binomial tree). Higher "
+       "fanout shortens the tree but concentrates load on early holders.")
+define("array_bcast_leg_timeout_s", float, 60.0,
+       "Deadline for one broadcast-tree leg (a member daemon's "
+       "coordinated pull). An expired or failed leg is dropped from the "
+       "tree and its member falls back to the classic pull path on "
+       "first get (zero loss; the directory still advertises holders).")
+
 # Scheduling
 define("worker_pool_min_size", int, 0, "Workers prestarted per node at boot.")
 define("worker_pool_max_size", int, 8, "Max concurrent leased workers per node.")

@@ -19,15 +19,32 @@ arrays as views without copying.
 
 from __future__ import annotations
 
+import mmap
 import pickle
 import struct
-from typing import Any, List, Tuple
+import sys
+import threading
+import weakref
+from typing import Any, List, Optional, Tuple
 
 import cloudpickle
 
 from ray_tpu.core.refs import ObjectRef
 
 _MAGIC = b"RTOB\x00\x00\x00\x01"
+# Array fast-path wire format (r16): a tiny fixed header instead of a
+# pickle program. Layout after the magic:
+#
+#     [1B flags][1B order][2B dtype_len][2B device_len][2B pad]
+#     [4B ndim][8B nbytes][ndim x 8B shape][dtype str][device str]
+#     [pad-to-64][raw buffer]
+#
+# flags bit 0: the value was a jax.Array (device-resident producer; the
+# ``device`` string records its placement). The buffer is the array's
+# bytes in MEMORY order; ``order`` ('C'/'F') says how to fold them back.
+_ARRAY_MAGIC = b"RTAR\x00\x00\x00\x01"
+_ARRAY_HDR = struct.Struct("<BBHHHIQ")
+_ARRAY_FLAG_JAX = 1
 _ALIGN = 64
 
 
@@ -80,6 +97,195 @@ def _restore_array(arr):
     return arr
 
 
+# ---------------------------------------------------------------------------
+# Array fast path (r16): top-level numpy/jax arrays skip pickle entirely —
+# a fixed RTAR header plus the raw buffer as a zero-copy segment, so
+# ObjectPlane.put copies the payload ONCE (straight into the shm mapping)
+# and deserialize returns a read-only view over the pinned mapping.
+# ---------------------------------------------------------------------------
+
+_zc_gen: Optional[int] = None
+_zc_v = True
+
+# Live read-only array views whose base is a pinned shm mapping: each
+# deserialized array registers a finalizer on the mmap, so the conftest
+# hygiene gate (and the rt_array_pins_live gauge) can assert no test
+# leaks a pin past its own teardown.
+_pin_lock = threading.Lock()
+_live_array_pins = 0
+
+
+def _zero_copy_enabled() -> bool:
+    """Generation-cached array_zero_copy_enabled read (serialize sits on
+    the put hot path; config.get walks os.environ)."""
+    global _zc_gen, _zc_v
+    from ray_tpu import config
+    if _zc_gen != config.generation:
+        _zc_v = bool(config.get("array_zero_copy_enabled"))
+        _zc_gen = config.generation
+    return _zc_v
+
+
+def _untrack_pin() -> None:
+    global _live_array_pins
+    with _pin_lock:
+        _live_array_pins -= 1
+
+
+def _track_pin(base) -> None:
+    global _live_array_pins
+    try:
+        weakref.finalize(base, _untrack_pin)
+    except TypeError:
+        return  # bytes-backed view: no store pin behind it
+    with _pin_lock:
+        _live_array_pins += 1
+
+
+def live_array_pins() -> int:
+    """Read-only array views still holding a shm pin (hygiene gate)."""
+    with _pin_lock:
+        return _live_array_pins
+
+
+def is_array_blob(buf) -> bool:
+    """True when a serialized blob (or its first segment) is an RTAR
+    array-header object (channel/plane callers dispatch on this)."""
+    m = memoryview(buf)
+    return m.nbytes >= 8 and bytes(m[:8]) == _ARRAY_MAGIC
+
+
+def array_header(buf) -> Optional[dict]:
+    """Parse an RTAR header without touching the payload — object-plane
+    placement tagging and debug tooling read dtype/shape/device from
+    the first segment only."""
+    m = memoryview(buf)
+    if m.nbytes < 8 + _ARRAY_HDR.size or bytes(m[:8]) != _ARRAY_MAGIC:
+        return None
+    flags, order, dtype_len, device_len, _r, ndim, nbytes = \
+        _ARRAY_HDR.unpack_from(m, 8)
+    off = 8 + _ARRAY_HDR.size
+    shape = struct.unpack_from(f"<{ndim}q", m, off)
+    off += 8 * ndim
+    dtype = bytes(m[off:off + dtype_len]).decode()
+    off += dtype_len
+    device = bytes(m[off:off + device_len]).decode()
+    return {"nbytes": nbytes, "shape": tuple(shape), "dtype": dtype,
+            "order": chr(order), "device": device,
+            "was_jax": bool(flags & _ARRAY_FLAG_JAX)}
+
+
+def _export_array(value):
+    """value -> (ndarray, was_jax, device) or None when not an exact
+    top-level array (or the export fault site failed it)."""
+    np = sys.modules.get("numpy")
+    if np is None:
+        return None
+    was_jax = False
+    device = ""
+    if type(value) is not np.ndarray:
+        jtypes = _JaxArrayPlaceholder.jax_array_types()
+        if not (jtypes and isinstance(value, jtypes)):
+            return None
+        was_jax = True
+        try:
+            device = str(next(iter(value.devices())))
+        except Exception:
+            device = ""
+        try:
+            from ray_tpu.cluster import fault_plane
+            fault_plane.fire("object.array.export", kind="jax")
+            # dlpack first: zero-copy for host-backed (CPU) arrays — the
+            # old path's np.asarray always paid a full host copy here.
+            value = np.from_dlpack(value)
+        except Exception:
+            try:
+                value = np.asarray(value)
+            except Exception:
+                return None
+        if type(value) is not np.ndarray:
+            return None
+    else:
+        try:
+            from ray_tpu.cluster import fault_plane
+            fault_plane.fire("object.array.export", kind="numpy")
+        except Exception:
+            return None  # injected export failure: classic pickle path
+    d = value.dtype
+    if d.hasobject or d.fields is not None:
+        return None
+    if not (value.flags.c_contiguous or value.flags.f_contiguous):
+        return None
+    return value, was_jax, device
+
+
+def _array_segments(value) -> Optional[Tuple[int, List]]:
+    """RTAR (total, segments) for a top-level array value, or None to
+    take the classic pickle path."""
+    if not _zero_copy_enabled():
+        return None
+    exported = _export_array(value)
+    if exported is None:
+        return None
+    arr, was_jax, device = exported
+    order = b"C" if arr.flags.c_contiguous else b"F"
+    # memoryview.cast requires C-contiguity; an F-ordered array's
+    # transpose is the same memory seen C-contiguously.
+    base = arr if arr.flags.c_contiguous else arr.T
+    try:
+        if arr.ndim == 0 or arr.size == 0:
+            # cast("B") rejects 0-d/empty views; the "copy" is one itemsize.
+            buf = memoryview(arr.tobytes())
+        else:
+            buf = memoryview(base)
+            if buf.format != "B" or buf.ndim != 1:
+                buf = buf.cast("B")
+    except (ValueError, TypeError):
+        return None  # datetime64 etc. refuse the buffer protocol
+    dtype_b = arr.dtype.str.encode()
+    device_b = device.encode()
+    flags = _ARRAY_FLAG_JAX if was_jax else 0
+    header = bytearray()
+    header += _ARRAY_MAGIC
+    header += _ARRAY_HDR.pack(flags, order[0], len(dtype_b), len(device_b),
+                              0, arr.ndim, arr.nbytes)
+    header += struct.pack(f"<{arr.ndim}q", *arr.shape)
+    header += dtype_b + device_b
+    header += b"\x00" * _pad(len(header))
+    segments: List = [bytes(header), buf]
+    total = len(segments[0]) + buf.nbytes
+    tail = _pad(total)
+    if tail:
+        segments.append(b"\x00" * tail)
+        total += tail
+    return total, segments
+
+
+def _deserialize_array(m: memoryview):
+    """RTAR blob -> read-only ndarray view over the blob's memory. When
+    ``m`` maps pinned shm, the array (and every slice of it) keeps the
+    pin alive until the last view is garbage collected."""
+    import numpy as np
+    hdr = array_header(m)
+    if hdr is None:
+        raise ValueError("bad array blob header")
+    ndim = len(hdr["shape"])
+    off = 8 + _ARRAY_HDR.size + 8 * ndim + len(hdr["dtype"]) \
+        + len(hdr["device"].encode())
+    body = off + _pad(off)
+    nbytes = hdr["nbytes"]
+    arr = np.frombuffer(m[body:body + nbytes], dtype=np.dtype(hdr["dtype"]))
+    arr = arr.reshape(hdr["shape"], order=hdr["order"])
+    try:
+        arr.flags.writeable = False
+    except Exception:
+        pass  # already read-only (PROT_READ mapping / bytes blob)
+    base = getattr(m, "obj", None)
+    if isinstance(base, mmap.mmap):
+        _track_pin(base)
+    return arr
+
+
 # Exact-type primitives cannot contain ObjectRefs or out-of-band buffers,
 # so their serialization skips the CloudPickler construction entirely
 # (~20us/call — dominant in the inline-return reply path, where task
@@ -103,6 +309,11 @@ def serialize_segments(value: Any) -> Tuple[int, List, List[ObjectRef]]:
         if pad:
             return total + pad, [seg0, b"\x00" * pad], []
         return total, [seg0], []
+
+    fast = _array_segments(value)
+    if fast is not None:
+        total, segments = fast
+        return total, segments, []
 
     import io
 
@@ -162,6 +373,8 @@ def deserialize(blob) -> Any:
     reconstructed as zero-copy views over that memory.
     """
     m = memoryview(blob)
+    if bytes(m[:8]) == _ARRAY_MAGIC:
+        return _deserialize_array(m)
     if bytes(m[:8]) != _MAGIC:
         raise ValueError("bad object blob magic")
     pickle_len, nbuf = struct.unpack_from("<QI", m, 8)

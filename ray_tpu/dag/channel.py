@@ -65,6 +65,9 @@ _FULL = 1
 # slot flags
 FLAG_POISON = 1           # payload is a serialized error; the graph unwinds
 FLAG_SPILL = 2            # payload is a 20-byte ObjectID (value > slot_bytes)
+FLAG_ARRAY = 4            # payload is an RTAR array blob (r16): the reader
+                          # rebuilds the value as an ndarray view with no
+                          # pickle program on either side
 
 _SPIN = 64                # polls before the first sleep
 
@@ -140,10 +143,16 @@ class _Ring:
 
     def write(self, seq: int, payload, flags: int,
               deadline: Optional[float], stop=None) -> None:
-        m = memoryview(payload)
-        if m.nbytes > self.slot_bytes:
+        # ``payload`` may be a list/tuple of buffer parts (r16 array
+        # values: [header, raw array buffer, pad]) — written back to back
+        # into the slot, so an array travels writer-memory -> slot in ONE
+        # copy with no intermediate join.
+        parts = payload if isinstance(payload, (list, tuple)) else (payload,)
+        views = [memoryview(p) for p in parts]
+        nbytes = sum(v.nbytes for v in views)
+        if nbytes > self.slot_bytes:
             raise ChannelError(
-                f"payload {m.nbytes}B exceeds slot capacity "
+                f"payload {nbytes}B exceeds slot capacity "
                 f"{self.slot_bytes}B (raise cgraph_slot_bytes)")
         if self.nonce is not None and \
                 bytes(self.mv[_OFF_NONCE:_OFF_NONCE + 8]) != self.nonce:
@@ -159,9 +168,12 @@ class _Ring:
         self._wait_state(off, _EMPTY, deadline, stop)
         mv = self.mv
         struct.pack_into("<Q", mv, off + 8, seq)
-        struct.pack_into("<I", mv, off + 4, m.nbytes)
+        struct.pack_into("<I", mv, off + 4, nbytes)
         mv[off + 1] = flags
-        mv[off + _SLOT_HDR:off + _SLOT_HDR + m.nbytes] = m
+        cur = off + _SLOT_HDR
+        for v in views:
+            mv[cur:cur + v.nbytes] = v
+            cur += v.nbytes
         mv[off] = _FULL    # publish: the payload stores precede this byte
         struct.pack_into("<Q", mv, _OFF_WRITE_SEQ,
                          struct.unpack_from("<Q", mv, _OFF_WRITE_SEQ)[0] + 1)
@@ -326,6 +338,13 @@ class RpcChannelWriter:
         from ray_tpu.cluster.protocol import oob
         if timeout is None:
             timeout = config.get("cgraph_write_timeout_s")
+        if isinstance(payload, (list, tuple)):
+            # Multi-part array payloads join here: the RPC frame needs one
+            # contiguous out-of-band segment (the remote forwarder's shm
+            # write is the single data copy either way).
+            payload = b"".join(memoryview(p).cast("B") if not
+                               isinstance(p, (bytes, bytearray)) else p
+                               for p in payload)
         try:
             fut = self._cli.call_async(
                 "channel_write", chan_id=self.chan_id, seq=seq,

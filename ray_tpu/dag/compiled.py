@@ -29,10 +29,10 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.refs import ChannelResolvedRef
-from ray_tpu.dag.channel import (FLAG_POISON, FLAG_SPILL, ChannelError,
-                                 ChannelTimeout, RpcChannelWriter,
-                                 ShmChannelReader, ShmChannelWriter,
-                                 make_channel_id)
+from ray_tpu.dag.channel import (FLAG_ARRAY, FLAG_POISON, FLAG_SPILL,
+                                 ChannelError, ChannelTimeout,
+                                 RpcChannelWriter, ShmChannelReader,
+                                 ShmChannelWriter, make_channel_id)
 from ray_tpu.dag.nodes import (ClassMethodNode, ClassNode, DAGNode,
                                FunctionNode, InputNode, MultiOutputNode)
 
@@ -56,14 +56,20 @@ def _fault_plane():
 # ---------------------------------------------------------------------------
 
 def _encode_value(value: Any, slot_bytes: int, plane) -> tuple:
-    """Serialize ``value`` for a channel slot. Oversized payloads spill to
-    the object store and ride the slot as a 20-byte ObjectID marker."""
+    """Serialize ``value`` for a channel slot. Array values that fit the
+    slot travel as RTAR segment lists (FLAG_ARRAY) — header + raw buffer,
+    no pickle, one copy into the ring. Oversized payloads spill to the
+    object store and ride the slot as a 20-byte ObjectID marker."""
     from ray_tpu.core import serialization
-    blob, _refs = serialization.serialize(value)
-    if len(blob) <= slot_bytes:
-        return blob, 0
+    total, segments, refs = serialization.serialize_segments(value)
+    if total <= slot_bytes:
+        if serialization.is_array_blob(segments[0]):
+            return segments, FLAG_ARRAY
+        if len(segments) == 1:
+            return segments[0], 0
+        return segments, 0
     oid = ObjectID.from_random()
-    plane.put_value(oid, value)
+    plane.put_segments(oid, total, segments, refs)
     return oid.binary(), FLAG_SPILL
 
 
@@ -71,6 +77,9 @@ def _decode_value(blob, flags: int, plane, timeout: float = 30.0) -> Any:
     from ray_tpu.core import serialization
     if flags & FLAG_SPILL:
         return plane.get_value(ObjectID(bytes(blob)), timeout=timeout)
+    # FLAG_ARRAY needs no special casing: deserialize dispatches on the
+    # RTAR magic and rebuilds the ndarray straight from the blob bytes
+    # (the slot copy-out already happened in ring.read — see channel.py).
     return serialization.deserialize(memoryview(blob))
 
 
@@ -98,9 +107,12 @@ def _write_slot(writer, seq: int, blob, flags: int,
             f"channel {writer.chan_id.hex()[:8]} severed (fault injection)")
     t0 = time.perf_counter()
     writer.write(seq, blob, flags, timeout=timeout, stop=stop)
+    nbytes = (sum(memoryview(b).nbytes for b in blob)
+              if isinstance(blob, (list, tuple))
+              else memoryview(blob).nbytes)
     _events().emit("cgraph.slot.write", writer.chan_id.hex()[:16],
                    value=time.perf_counter() - t0,
-                   attrs={"bytes": memoryview(blob).nbytes})
+                   attrs={"bytes": nbytes})
 
 
 def _read_slot(reader, seq: int, timeout: Optional[float],

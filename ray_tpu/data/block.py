@@ -104,14 +104,23 @@ class BlockAccessor:
                     else np.concatenate(parts, axis=0)
             elif pa.types.is_fixed_size_list(col.type):
                 # legacy metadata-shaped tensor blocks (pre-extension)
-                flat = col.combine_chunks().flatten().to_numpy(
-                    zero_copy_only=False)
+                values = col.combine_chunks().flatten()
+                try:
+                    # Null-free primitive storage reshapes over the Arrow
+                    # buffer directly — the copying path was doubling
+                    # every batch (r16 block-conversion fix).
+                    flat = values.to_numpy(zero_copy_only=True)
+                except (pa.ArrowInvalid, ValueError):
+                    flat = values.to_numpy(zero_copy_only=False)
                 n = self.block.num_rows
                 shape = shapes.get(name)
                 out[name] = flat.reshape((n, -1) if shape is None
                                          else (n, *shape))
             else:
-                out[name] = col.to_numpy(zero_copy_only=False)
+                try:
+                    out[name] = col.to_numpy(zero_copy_only=True)
+                except (pa.ArrowInvalid, ValueError):
+                    out[name] = col.to_numpy(zero_copy_only=False)
         return out
 
     def to_pandas(self):

@@ -61,10 +61,21 @@ class ArrowTensorArray(pa.ExtensionArray):
         arr = np.ascontiguousarray(arr)
         if arr.ndim < 2:
             raise ValueError("tensor columns need ndim >= 2 (N, *shape)")
-        typ = ArrowTensorType(arr.shape[1:], pa.from_numpy_dtype(arr.dtype))
+        value_type = pa.from_numpy_dtype(arr.dtype)
+        typ = ArrowTensorType(arr.shape[1:], value_type)
         flat = arr.reshape(len(arr), -1)
+        values = flat.ravel()
+        if arr.dtype != np.bool_:
+            # Wrap the ndarray's own buffer instead of pa.array()'s
+            # element-wise copy: batch blocks were paying an extra host
+            # copy per column on every iter_batches conversion. Excluded
+            # for bool (Arrow bit-packs; numpy is byte-per-element).
+            value_arr = pa.Array.from_buffers(
+                value_type, len(values), [None, pa.py_buffer(values)])
+        else:
+            value_arr = pa.array(values)
         storage = pa.FixedSizeListArray.from_arrays(
-            pa.array(flat.ravel()), flat.shape[1])
+            value_arr, flat.shape[1])
         return pa.ExtensionArray.from_storage(typ, storage)
 
     def to_numpy(self, zero_copy_only: bool = True) -> np.ndarray:

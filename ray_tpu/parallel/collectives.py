@@ -73,3 +73,37 @@ def axis_index(axis: str):
 
 def axis_size(axis: str):
     return _compat.axis_size(axis)
+
+
+def broadcast_rounds(n: int, *, fanout: int = 2, root: int = 0):
+    """Host-level broadcast schedule: rounds of (src, dst) legs spreading
+    one copy from ``root`` to all ``n`` members, each holder re-sending to
+    up to ``fanout`` new members per round (binomial tree at fanout=2, so
+    ceil(log2 n) rounds instead of the n-1 serial pulls of the classic
+    path). Pure schedule — the object plane drives the legs over the r08
+    pipelined RPC layer (the CPU-host, gloo-style stand-in for an ICI
+    collective; reference python/ray/util/collective gloo backend role).
+
+    Members are 0..n-1; legs inside a round are independent and may run
+    concurrently. A failed leg is the caller's problem (it re-stripes the
+    missing member onto the classic pull path).
+    """
+    if n <= 0:
+        return []
+    if fanout < 1:
+        fanout = 1
+    have = [root % n]
+    pending = [i for i in range(n) if i != root % n]
+    rounds = []
+    while pending:
+        legs = []
+        senders = list(have)
+        for src in senders:
+            for _ in range(fanout):
+                if not pending:
+                    break
+                dst = pending.pop(0)
+                legs.append((src, dst))
+                have.append(dst)
+        rounds.append(legs)
+    return rounds

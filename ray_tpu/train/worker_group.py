@@ -103,6 +103,10 @@ class RayTrainWorker:
         return True
 
 
+def _identity(x):
+    return x
+
+
 def _accepts_config(fn: Callable) -> bool:
     import inspect
     try:
@@ -166,6 +170,22 @@ class WorkerGroup:
         import ray_tpu as rt
         return rt.get([w.execute.remote(fn, *args, **kwargs)
                        for w in self.workers], timeout=600)
+
+    def broadcast_weights(self, params: Any,
+                          apply_fn: Optional[Callable] = None) -> List[Any]:
+        """Ship one weight payload to every rank via the collective-backed
+        object plane (r16): ONE put + a broadcast tree pre-places the
+        object on each distinct worker node, then every rank resolves it
+        from its local store as a read-only array view. ``apply_fn(params)``
+        runs on each rank with the resolved value (default: return it)."""
+        import ray_tpu as rt
+        from ray_tpu.train import weight_sync
+        ref = weight_sync.broadcast_to_actors(params, self.workers)
+        if apply_fn is None:
+            futs = [w.execute.remote(_identity, ref) for w in self.workers]
+        else:
+            futs = [w.execute.remote(apply_fn, ref) for w in self.workers]
+        return rt.get(futs, timeout=600)
 
     def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
         import ray_tpu as rt

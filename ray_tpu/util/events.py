@@ -73,6 +73,13 @@ EVENT_KINDS: Dict[str, str] = {
     "object.put.backpressure": "value = delay seconds",
     "inline.hit": "value = inline bytes served from cache",
     "inline.miss": "value unused; ident = object id",
+    # device-native array objects (r16)
+    "object.array.put": "value = array blob bytes stored zero-copy",
+    "object.bcast.leg": "value = bytes moved by one broadcast tree leg",
+    "object.bcast.done": "value = broadcast seconds; attrs carry "
+                         "members/bytes/fallback",
+    "object.bcast.fallback": "value = members re-striped onto the "
+                             "classic pull path",
     # spill / evict tier
     "object.spill.write": "value = bytes spilled",
     "object.spill.restore": "value = bytes restored",
@@ -356,6 +363,16 @@ def _fold_metrics(evs: List[tuple], dropped: int) -> None:
             m.builtin(C, "rt_evict_bytes_total").inc(value)
         elif kind == "object.put.backpressure":
             m.builtin(C, "rt_put_backpressure_total").inc()
+        elif kind == "object.array.put":
+            m.builtin(C, "rt_array_puts_total").inc()
+            m.builtin(C, "rt_array_put_bytes_total").inc(value)
+        elif kind == "object.bcast.leg":
+            m.builtin(C, "rt_bcast_legs_total").inc()
+            m.builtin(C, "rt_bcast_bytes_total").inc(value)
+        elif kind == "object.bcast.done":
+            m.builtin(C, "rt_bcast_total").inc()
+        elif kind == "object.bcast.fallback":
+            m.builtin(C, "rt_bcast_fallback_total").inc(value or 1)
         elif kind == "inline.hit":
             m.builtin(C, "rt_inline_cache_hits_total").inc(value or 1)
         elif kind == "inline.miss":

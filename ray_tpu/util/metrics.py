@@ -72,6 +72,15 @@ METRICS: Dict[str, str] = {
     "rt_inline_pending_returns": "inline returns awaiting seal",
     "rt_inline_seals_total": "inline returns sealed",
     "rt_location_batch_backlog": "location-update batches queued",
+    # device-native array objects (r16)
+    "rt_array_puts_total": "array objects stored via the zero-copy path",
+    "rt_array_put_bytes_total": "bytes stored via the array fast path",
+    "rt_array_pins_live": "read-only array views pinning shm mappings",
+    "rt_bcast_total": "collective-backed object broadcasts completed",
+    "rt_bcast_legs_total": "broadcast tree legs completed",
+    "rt_bcast_bytes_total": "bytes moved by broadcast tree legs",
+    "rt_bcast_fallback_total": "broadcast members re-striped onto the "
+                               "classic pull path",
     # spill / evict tier
     "rt_spill_objects_total": "primaries spilled to the durable tier",
     "rt_spill_bytes_total": "bytes spilled to the durable tier",

@@ -69,6 +69,23 @@ def _cgraph_hygiene(request):
                                     timeout=15)
                 assert n == 0, \
                     f"test leaked {n} DRAINING serve replicas"
+    if "test_device_object_plane" in nodeid:
+        # Array-pin hygiene (r16): every read-only array view handed out
+        # by rt.get/get_view pins its shm mapping; a test must not leak
+        # one past its own teardown (the fixture-scoped cluster would
+        # carry the pin — and the segment — across tests).
+        import gc
+        import time
+
+        from ray_tpu.core import serialization
+        gc.collect()
+        deadline = time.monotonic() + 2.0
+        while serialization.live_array_pins() and time.monotonic() < deadline:
+            time.sleep(0.05)   # finalizers may run a beat late
+            gc.collect()
+        assert serialization.live_array_pins() == 0, (
+            f"test leaked {serialization.live_array_pins()} live array "
+            "pin(s) (read-only array views still holding shm mappings)")
     if ("test_compiled_dag" not in nodeid
             and "test_pipeline_train" not in nodeid):
         return
